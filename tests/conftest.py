@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip hardware isn't available in CI; sharded paths are validated on a
+virtual CPU mesh (jax's xla_force_host_platform_device_count), matching the
+driver's dryrun_multichip environment.  Must run before jax is imported.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,134 @@
+"""Tests for CpuState: regs.json loading + sanitize rules."""
+
+import json
+
+from wtf_tpu.core import CpuState, load_cpu_state_json, sanitize_cpu_state
+from wtf_tpu.core.cpustate import GPR_NAMES
+
+
+def _sample_regs(tmp_path, **overrides):
+    regs = {
+        "rax": "0x1122334455667788",
+        "rbx": "0x2",
+        "rcx": "0x3",
+        "rdx": "0x4",
+        "rsi": "0x5",
+        "rdi": "0x6",
+        "rip": "0x7ff7b0001000",
+        "rsp": "0x14ff20",
+        "rbp": "0x14ff80",
+        "r8": "0x8",
+        "r9": "0x9",
+        "r10": "0xa",
+        "r11": "0xb",
+        "r12": "0xc",
+        "r13": "0xd",
+        "r14": "0xe",
+        "r15": "0xf",
+        "rflags": "0x246",
+        "tsc": "0x1234",
+        "cr0": "0x80050031",
+        "cr2": "0x0",
+        "cr3": "0x6d4000",
+        "cr4": "0x370678",
+        "cr8": "0xf",
+        "dr7": "0x400",
+        "efer": "0xd01",
+        "mxcsr": "0x1f80",
+        "mxcsr_mask": "0x0",
+        "fptw": "0x0",
+        "fpst": ["0xInfinity"] * 8,
+        "cs": {
+            "present": True,
+            "selector": "0x33",
+            "base": "0x0",
+            "limit": "0xffffffff",
+            "attr": "0xaffb",
+        },
+        "fs": {
+            "present": True,
+            "selector": "0x53",
+            "base": "0x12345000",
+            "limit": "0x3c00",
+            "attr": "0xf3",
+        },
+        "gdtr": {"base": "0xfffff8007b5fb000", "limit": "0x57"},
+    }
+    regs.update(overrides)
+    path = tmp_path / "regs.json"
+    path.write_text(json.dumps(regs))
+    return path
+
+
+def test_load_basic_registers(tmp_path):
+    state = load_cpu_state_json(_sample_regs(tmp_path))
+    assert state.rax == 0x1122334455667788
+    assert state.rip == 0x7FF7B0001000
+    assert state.rflags == 0x246
+    assert state.cr3 == 0x6D4000
+    assert state.efer == 0xD01
+    assert state.long_mode()
+    assert state.paging_enabled()
+
+
+def test_load_segments_and_gdtr(tmp_path):
+    state = load_cpu_state_json(_sample_regs(tmp_path))
+    assert state.cs.selector == 0x33
+    assert state.cs.present
+    assert state.fs.base == 0x12345000
+    assert state.gdtr.base == 0xFFFFF8007B5FB000
+    assert state.gdtr.limit == 0x57
+
+
+def test_fptw_windbg_workaround(tmp_path):
+    # fptw==0 with all-Infinity x87 slots means windbg didn't capture the FPU:
+    # the loader must force an empty tag word (ref utils.cc:156-191).
+    state = load_cpu_state_json(_sample_regs(tmp_path))
+    assert state.fptw == 0xFFFF
+    assert state.fpst == [0] * 8
+
+
+def test_sanitize_rules(tmp_path):
+    state = load_cpu_state_json(_sample_regs(tmp_path))
+    assert sanitize_cpu_state(state)
+    # rip is user-mode -> cr8 forced to 0 (ref utils.cc:200-206)
+    assert state.cr8 == 0
+    # debug registers cleared (ref utils.cc:208-227)
+    assert state.dr7 == 0
+    # mxcsr_mask defaulted (ref utils.cc:244-252)
+    assert state.mxcsr_mask == 0xFFBF
+
+
+def test_sanitize_rejects_bad_segment(tmp_path):
+    # limit[16:20] copy lives in attr bits 8..11; a mismatch is fatal
+    # (ref utils.cc:229-242).
+    path = _sample_regs(
+        tmp_path,
+        cs={
+            "present": True,
+            "selector": "0x33",
+            "base": "0x0",
+            "limit": "0xffffffff",
+            "attr": "0x02fb",  # reserved nibble 0x2 != limit[16:20]==0xf
+        },
+    )
+    state = load_cpu_state_json(path)
+    assert not sanitize_cpu_state(state)
+
+
+def test_gpr_roundtrip():
+    state = CpuState()
+    values = list(range(16))
+    state.set_gpr_list(values)
+    assert state.gpr_list() == values
+    assert state.rsp == 4  # GPR_NAMES order is x86 encoding order
+    assert GPR_NAMES[4] == "rsp"
+
+
+def test_copy_is_deep():
+    state = CpuState()
+    clone = state.copy()
+    clone.fpst[0] = 42
+    clone.cs.selector = 0x10
+    assert state.fpst[0] == 0
+    assert state.cs.selector == 0

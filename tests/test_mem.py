@@ -1,0 +1,270 @@
+"""Memory subsystem tests: physmem image, dirty overlay, paging, virt I/O."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wtf_tpu.core.gxa import PAGE_SIZE
+from wtf_tpu.mem.overlay import (
+    overlay_init,
+    overlay_reset,
+    phys_read,
+    phys_read_u64,
+    phys_write,
+)
+from wtf_tpu.mem.paging import translate, virt_read, virt_read_u64, virt_write
+from wtf_tpu.mem.physmem import PhysMem
+from wtf_tpu.snapshot.synthetic import SyntheticSnapshotBuilder
+
+
+def _lane(overlay, i=0):
+    """Extract lane i's overlay view (what vmap hands the per-lane fns)."""
+    return jax.tree.map(lambda x: x[i], overlay)
+
+
+def _merge_lane(overlay, lane_overlay, i=0):
+    return jax.tree.map(lambda full, one: full.at[i].set(one), overlay, lane_overlay)
+
+
+@pytest.fixture(scope="module")
+def simple_mem():
+    pages = {
+        3: bytes(range(256)) * 16,
+        5: b"\xAA" * PAGE_SIZE,
+        6: b"\xBB" * PAGE_SIZE,
+    }
+    return PhysMem.from_pages(pages)
+
+
+def test_phys_read_base(simple_mem):
+    ov = _lane(overlay_init(1, 4))
+    data = phys_read(simple_mem.image, ov, jnp.uint64(3 * PAGE_SIZE + 1), 4)
+    assert list(np.asarray(data)) == [1, 2, 3, 4]
+
+
+def test_phys_read_absent_page_is_zero(simple_mem):
+    ov = _lane(overlay_init(1, 4))
+    data = phys_read(simple_mem.image, ov, jnp.uint64(0x100 * PAGE_SIZE), 8)
+    assert list(np.asarray(data)) == [0] * 8
+    # out of frame-table range too
+    data = phys_read(simple_mem.image, ov, jnp.uint64(1 << 40), 8)
+    assert list(np.asarray(data)) == [0] * 8
+
+
+def test_phys_read_page_crossing(simple_mem):
+    ov = _lane(overlay_init(1, 4))
+    gpa = jnp.uint64(5 * PAGE_SIZE + PAGE_SIZE - 2)
+    data = phys_read(simple_mem.image, ov, gpa, 4)
+    assert list(np.asarray(data)) == [0xAA, 0xAA, 0xBB, 0xBB]
+
+
+def test_phys_write_copy_on_write(simple_mem):
+    ov = _lane(overlay_init(1, 4))
+    gpa = jnp.uint64(3 * PAGE_SIZE + 10)
+    ov, ok = phys_write(
+        simple_mem.image, ov, gpa, jnp.array([9, 9], dtype=jnp.uint8), jnp.bool_(True)
+    )
+    assert bool(ok)
+    assert int(ov.count) == 1
+    # Readback sees the overlay; neighbors keep base content (CoW copied page).
+    data = phys_read(simple_mem.image, ov, gpa - jnp.uint64(1), 4)
+    assert list(np.asarray(data)) == [9, 9, 9, 12]
+    # Base image untouched.
+    assert simple_mem.host_read(3 * PAGE_SIZE + 10, 2) == bytes([10, 11])
+
+
+def test_phys_write_disabled_is_noop(simple_mem):
+    ov = _lane(overlay_init(1, 4))
+    ov, _ = phys_write(
+        simple_mem.image,
+        ov,
+        jnp.uint64(3 * PAGE_SIZE),
+        jnp.array([1], dtype=jnp.uint8),
+        jnp.bool_(False),
+    )
+    assert int(ov.count) == 0
+    data = phys_read(simple_mem.image, ov, jnp.uint64(3 * PAGE_SIZE), 1)
+    assert int(data[0]) == 0
+
+
+def test_phys_write_crossing_and_reset(simple_mem):
+    ov = _lane(overlay_init(1, 4))
+    gpa = jnp.uint64(5 * PAGE_SIZE + PAGE_SIZE - 1)
+    ov, ok = phys_write(
+        simple_mem.image, ov, gpa, jnp.array([1, 2], dtype=jnp.uint8), jnp.bool_(True)
+    )
+    assert bool(ok)
+    assert int(ov.count) == 2  # both pages went dirty
+    data = phys_read(simple_mem.image, ov, gpa, 2)
+    assert list(np.asarray(data)) == [1, 2]
+    # Restore: O(1) reset drops all dirty data.
+    ov = overlay_reset(ov)
+    assert int(ov.count) == 0
+    data = phys_read(simple_mem.image, ov, gpa, 2)
+    assert list(np.asarray(data)) == [0xAA, 0xBB]
+
+
+def test_overlay_overflow_flag(simple_mem):
+    ov = _lane(overlay_init(1, 2))
+    for pfn in (3, 5, 6):
+        ov, ok = phys_write(
+            simple_mem.image,
+            ov,
+            jnp.uint64(pfn * PAGE_SIZE),
+            jnp.array([7], dtype=jnp.uint8),
+            jnp.bool_(True),
+        )
+    assert bool(ov.overflow)
+    assert not bool(ok)
+
+
+def test_overlay_vmap_lanes(simple_mem):
+    """Each lane's overlay is independent under vmap."""
+    n = 4
+    ov = overlay_init(n, 4)
+    gpas = jnp.array([3 * PAGE_SIZE, 5 * PAGE_SIZE, 6 * PAGE_SIZE, 3 * PAGE_SIZE], dtype=jnp.uint64)
+    vals = jnp.arange(n, dtype=jnp.uint8)[:, None]
+
+    def write_one(ov_lane, gpa, val):
+        new_ov, ok = phys_write(simple_mem.image, ov_lane, gpa, val, jnp.bool_(True))
+        return new_ov, ok
+
+    ov2, oks = jax.vmap(write_one, in_axes=(0, 0, 0))(ov, gpas, vals)
+    assert bool(jnp.all(oks))
+
+    def read_one(ov_lane, gpa):
+        return phys_read(simple_mem.image, ov_lane, gpa, 1)
+
+    out = jax.vmap(read_one, in_axes=(0, 0))(ov2, gpas)
+    assert list(np.asarray(out[:, 0])) == [0, 1, 2, 3]
+
+
+@pytest.fixture(scope="module")
+def paged_guest():
+    b = SyntheticSnapshotBuilder()
+    b.write(0x140000000, b"CODEPAGE" * 512)           # 4 KiB at an exe-like GVA
+    b.write(0x7FFE0000, bytes([0x11] * 32))           # another mapping
+    b.map_discontiguous_pair(0x200000000)             # crossing test region
+    b.write(0x200000000 + PAGE_SIZE - 4, b"ABCDEFGH", map_if_needed=False)
+    pages, cpu = b.build(rip=0x140000000, rsp=0x7FFE0F00)
+    return PhysMem.from_pages(pages), cpu
+
+
+def test_translate_4k(paged_guest):
+    mem, cpu = paged_guest
+    ov = _lane(overlay_init(1, 4))
+    tr = translate(mem.image, ov, jnp.uint64(cpu.cr3), jnp.uint64(0x140000123))
+    assert bool(tr.ok)
+    data = phys_read(mem.image, ov, tr.gpa, 5)
+    assert bytes(np.asarray(data)) == (b"CODEPAGE" * 512)[0x123:0x128]
+
+
+def test_translate_unmapped(paged_guest):
+    mem, cpu = paged_guest
+    ov = _lane(overlay_init(1, 4))
+    tr = translate(mem.image, ov, jnp.uint64(cpu.cr3), jnp.uint64(0xDEADBEEF000))
+    assert not bool(tr.ok)
+    # non-canonical
+    tr = translate(mem.image, ov, jnp.uint64(cpu.cr3), jnp.uint64(0x8000_0000_0000))
+    assert not bool(tr.ok)
+
+
+def test_translate_large_page():
+    b = SyntheticSnapshotBuilder()
+    b.write(0x1000000, b"X" * 16)  # force PML4/PDPT/PD creation nearby
+    b.add_large_page_mapping(0x1200000, 0x400000, 21)  # 2 MiB page GVA->GPA
+    pages, cpu = b.build()
+    pages[0x400000 >> 12] = b"\xCC" * PAGE_SIZE
+    mem = PhysMem.from_pages(pages)
+    ov = _lane(overlay_init(1, 4))
+    tr = translate(mem.image, ov, jnp.uint64(cpu.cr3), jnp.uint64(0x1200000 + 0x1234))
+    assert bool(tr.ok)
+    assert int(tr.gpa) == 0x400000 + 0x1234
+
+
+def test_virt_read_write_roundtrip(paged_guest):
+    mem, cpu = paged_guest
+    ov = _lane(overlay_init(1, 8))
+    cr3 = jnp.uint64(cpu.cr3)
+    gva = jnp.uint64(0x7FFE0000)
+    ov, fault = virt_write(
+        mem.image, ov, cr3, gva, jnp.asarray(list(b"hello!"), dtype=jnp.uint8), jnp.bool_(True)
+    )
+    assert not bool(fault)
+    data, fault = virt_read(mem.image, ov, cr3, gva, 6)
+    assert not bool(fault)
+    assert bytes(np.asarray(data)) == b"hello!"
+
+
+def test_virt_crossing_discontiguous_phys(paged_guest):
+    """Virtually contiguous pages map to non-adjacent frames; reads and
+    writes must stitch the two spans correctly."""
+    mem, cpu = paged_guest
+    ov = _lane(overlay_init(1, 8))
+    cr3 = jnp.uint64(cpu.cr3)
+    gva = jnp.uint64(0x200000000 + PAGE_SIZE - 4)
+    data, fault = virt_read(mem.image, ov, cr3, gva, 8)
+    assert not bool(fault)
+    assert bytes(np.asarray(data)) == b"ABCDEFGH"
+
+    ov, fault = virt_write(
+        mem.image, ov, cr3, gva, jnp.asarray(list(b"12345678"), dtype=jnp.uint8), jnp.bool_(True)
+    )
+    assert not bool(fault)
+    assert int(ov.count) == 2
+    data, _ = virt_read(mem.image, ov, cr3, gva, 8)
+    assert bytes(np.asarray(data)) == b"12345678"
+
+
+def test_virt_read_u64(paged_guest):
+    mem, cpu = paged_guest
+    ov = _lane(overlay_init(1, 4))
+    val, fault = virt_read_u64(
+        mem.image, ov, jnp.uint64(cpu.cr3), jnp.uint64(0x140000000)
+    )
+    assert not bool(fault)
+    import struct
+
+    assert int(val) == struct.unpack("<Q", b"CODEPAGE")[0]
+
+
+def test_virt_fault_on_unmapped(paged_guest):
+    mem, cpu = paged_guest
+    ov = _lane(overlay_init(1, 4))
+    data, fault = virt_read(
+        mem.image, ov, jnp.uint64(cpu.cr3), jnp.uint64(0x666000), 4
+    )
+    assert bool(fault)
+    ov, fault = virt_write(
+        mem.image,
+        ov,
+        jnp.uint64(cpu.cr3),
+        jnp.uint64(0x666000),
+        jnp.array([1], dtype=jnp.uint8),
+        jnp.bool_(True),
+    )
+    assert bool(fault)
+    assert int(ov.count) == 0  # faulting write allocated nothing
+
+
+def test_virt_write_readonly_enforcement():
+    b = SyntheticSnapshotBuilder()
+    b.map(0x5000000, PAGE_SIZE, writable=False)
+    b.write(0x5000000, b"RO" * 8, map_if_needed=False)
+    pages, cpu = b.build()
+    mem = PhysMem.from_pages(pages)
+    ov = _lane(overlay_init(1, 4))
+    cr3 = jnp.uint64(cpu.cr3)
+    vals = jnp.asarray(list(b"XX"), dtype=jnp.uint8)
+    # Guest-store path faults on the read-only mapping...
+    ov, fault = virt_write(mem.image, ov, cr3, jnp.uint64(0x5000000), vals,
+                           jnp.bool_(True), enforce_writable=True)
+    assert bool(fault)
+    # ...but the host path writes through protection (reference VirtWrite
+    # semantics, backend.cc:91-127).
+    ov, fault = virt_write(mem.image, ov, cr3, jnp.uint64(0x5000000), vals,
+                           jnp.bool_(True))
+    assert not bool(fault)
+    data, _ = virt_read(mem.image, ov, cr3, jnp.uint64(0x5000000), 2)
+    assert bytes(np.asarray(data)) == b"XX"

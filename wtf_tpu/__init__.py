@@ -1,0 +1,33 @@
+"""tpu-wtf: a TPU-native, distributed, coverage-guided, snapshot-based fuzzer.
+
+Brand-new framework with the capabilities of the reference fuzzer (m4drat/wtf,
+see SURVEY.md): where the reference runs one testcase at a time inside
+bochscpu/WHV/KVM, this framework executes *batches* of mutated testcases in
+lockstep as a vmapped JAX x86-64 interpreter over an HBM-resident snapshot
+image, with lane-masked divergent control flow, device-side coverage bitmaps,
+and dirty-page restore as O(1) overlay reset.
+
+Layering (mirrors SURVEY.md section 1's layer map, redesigned TPU-first):
+  core/     - strong address types, CpuState, options, result variants (L1)
+  snapshot/ - snapshot loaders: kdmp / raw / synthetic               (L1)
+  mem/      - physical memory image, paging, per-lane dirty overlay  (L1/L2)
+  interp/   - the vmapped fetch-decode-execute x86-64 interpreter    (L2)
+  backend/  - Backend contract + TpuBackend                          (L2)
+  symbols/  - symbol store (debugger layer, Linux-mode path)         (L3)
+  harness/  - target registry, crash detection, guest-fs emulation   (L4)
+  fuzz/     - corpus, mutators                                       (L5)
+  dist/     - master/client TCP plane                                (L5)
+  parallel/ - device mesh sharding, multi-chip coverage reduction    (L5)
+  trace/    - rip/cov/tenet trace writers                            (aux)
+  cli.py    - `master|fuzz|run` subcommands                          (L6)
+"""
+
+import jax
+
+# The guest is an x86-64 machine: 64-bit GPRs, 64-bit linear addresses.
+# Enable x64 so uint64 is a real dtype everywhere (XLA lowers 64-bit integer
+# ops to 32-bit pairs on TPU; correctness first, the Pallas hot path works on
+# packed 32-bit lanes).
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,65 @@
+"""Testcase result variants.
+
+Equivalent of the reference's `TestcaseResult_t = std::variant<Ok_t, Timedout_t,
+Cr3Change_t, Crash_t>` (reference src/wtf/backend.h:12-31).  A crash carries a
+name used as the on-disk filename under crashes/ (server.h:861-877).
+
+These also define the integer status codes the interpreter keeps per lane on
+device; `StatusCode` is the device-side encoding, the dataclasses are the
+host-side API objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Union
+
+
+class StatusCode(enum.IntEnum):
+    """Per-lane execution status, kept as int32 on device."""
+
+    RUNNING = 0
+    OK = 1          # a stop breakpoint ended the testcase cleanly
+    TIMEDOUT = 2    # instruction limit reached
+    CR3_CHANGE = 3  # context switch detected (cr3 write != snapshot cr3)
+    CRASH = 4       # guest crashed (fault, bugcheck, harness-detected)
+    BREAKPOINT = 5  # paused at a breakpoint awaiting host servicing
+    UNSUPPORTED = 6 # interpreter hit an unimplemented instruction
+    PAGE_FAULT = 7  # unresolvable translation (pending host/guest servicing)
+
+
+@dataclasses.dataclass(frozen=True)
+class Ok:
+    def __str__(self) -> str:
+        return "ok"
+
+
+@dataclasses.dataclass(frozen=True)
+class Timedout:
+    def __str__(self) -> str:
+        return "timedout"
+
+
+@dataclasses.dataclass(frozen=True)
+class Cr3Change:
+    def __str__(self) -> str:
+        return "cr3change"
+
+
+@dataclasses.dataclass(frozen=True)
+class Crash:
+    """A crash with an optional name; named crashes get saved to disk
+    (reference backend.cc:204-212 SaveCrash / server.h:861-877)."""
+
+    name: Optional[str] = None
+
+    def __str__(self) -> str:
+        return f"crash({self.name or '?'})"
+
+
+TestcaseResult = Union[Ok, Timedout, Cr3Change, Crash]
+
+
+def is_crash(result: TestcaseResult) -> bool:
+    return isinstance(result, Crash)

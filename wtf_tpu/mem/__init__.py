@@ -1,0 +1,2 @@
+from wtf_tpu.mem.physmem import PhysMem
+from wtf_tpu.mem.overlay import DirtyOverlay, overlay_init, overlay_reset

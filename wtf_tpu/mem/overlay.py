@@ -1,0 +1,207 @@
+"""Per-lane dirty-page overlay: copy-on-write guest memory.
+
+This is the TPU-native replacement for the reference's dirty-page tracking +
+restore machinery (bochs write hooks bochscpu_backend.cc:550-593, KVM dirty
+bitmaps kvm_backend.cc:1568-1637, WHV R-X write-protection faults
+whv_backend.cc:1163-1189, and `Ram_t::Restore` ram.h:235-280).  Instead of
+mutating guest RAM and rolling dirty pages back after every testcase, each
+lane owns a small copy-on-write overlay: the first write to a page copies it
+from the shared HBM image into the lane's overlay slot, and every later
+read/write checks the overlay first.  `Restore()` is then a counter reset —
+no page data ever moves.
+
+All functions here operate on a SINGLE lane's overlay and are `vmap`ped over
+the lane axis by the interpreter (MemImage broadcast, Overlay mapped).
+
+Memory accesses are at most `PAGE_SIZE` bytes, so they touch at most two
+pages.  The core primitives (`gather_bytes` / `scatter_bytes`) therefore take
+a per-byte GPA vector plus a boolean mask saying which of the two candidate
+pages (that of byte 0 / that of byte size-1) each byte belongs to.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from wtf_tpu.core.gxa import PAGE_SHIFT, PAGE_SIZE
+from wtf_tpu.mem.physmem import MemImage, frame_slot
+
+# pfn sentinel for "out of physical range" — never matches a stored pfn and
+# frame_slot() maps it to the zero page.  Plain int: module import must not
+# touch the device (jnp scalars would initialize the backend).
+_PFN_OOB = 0x7FFFFFFF
+
+
+class DirtyOverlay(NamedTuple):
+    """One lane's dirty pages (batched: leading lane axis on every field)."""
+
+    pfn: jax.Array       # int32[capacity]; -1 = free slot
+    data: jax.Array      # uint8[capacity, PAGE_SIZE]
+    count: jax.Array     # int32 scalar: allocated slots
+    overflow: jax.Array  # bool scalar: lane ran out of overlay slots
+
+
+def overlay_init(n_lanes: int, capacity: int) -> DirtyOverlay:
+    """Allocate the batched overlay store for `n_lanes` lanes."""
+    return DirtyOverlay(
+        pfn=jnp.full((n_lanes, capacity), -1, dtype=jnp.int32),
+        data=jnp.zeros((n_lanes, capacity, PAGE_SIZE), dtype=jnp.uint8),
+        count=jnp.zeros((n_lanes,), dtype=jnp.int32),
+        overflow=jnp.zeros((n_lanes,), dtype=bool),
+    )
+
+
+def overlay_reset(overlay: DirtyOverlay) -> DirtyOverlay:
+    """Restore(): drop every dirty page, O(1) in page data.
+
+    Replaces `Ram_t::Restore` + per-backend dirty loops (ram.h:235-280)."""
+    return DirtyOverlay(
+        pfn=jnp.full_like(overlay.pfn, -1),
+        data=overlay.data,  # stale data is unreachable once pfn is -1
+        count=jnp.zeros_like(overlay.count),
+        overflow=jnp.zeros_like(overlay.overflow),
+    )
+
+
+def split_gpa(image: MemImage, gpa: jax.Array):
+    """gpa (uint64) -> (pfn int32 with OOB sentinel, offset int32)."""
+    nframes = image.frame_table.shape[0]
+    pfn64 = gpa >> PAGE_SHIFT
+    in_range = pfn64 < jnp.uint64(nframes)
+    pfn = jnp.where(in_range, pfn64, jnp.uint64(_PFN_OOB)).astype(jnp.int32)
+    off = (gpa & jnp.uint64(PAGE_SIZE - 1)).astype(jnp.int32)
+    return pfn, off
+
+
+def lookup(overlay: DirtyOverlay, pfn: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Find `pfn` in this lane's overlay -> (slot index, hit)."""
+    eq = overlay.pfn == pfn
+    idx = jnp.argmax(eq).astype(jnp.int32)
+    hit = eq[idx]
+    return idx, hit
+
+
+def ensure_page(
+    image: MemImage, overlay: DirtyOverlay, pfn: jax.Array, enabled: jax.Array
+) -> Tuple[DirtyOverlay, jax.Array, jax.Array]:
+    """Make `pfn` resident in the overlay (copy-on-write) when `enabled`.
+
+    Returns (overlay', slot index, ok).  ok=False when the overlay is full
+    (the run loop surfaces that lane as a hard error) or pfn is out of range.
+    """
+    capacity = overlay.pfn.shape[0]
+    idx0, hit = lookup(overlay, pfn)
+
+    in_range = pfn != _PFN_OOB
+    can_alloc = overlay.count < capacity
+    do_alloc = enabled & ~hit & can_alloc & in_range
+    idx = jnp.where(hit, idx0, overlay.count % capacity).astype(jnp.int32)
+
+    base = image.pages[frame_slot(image, pfn)]
+    new_row = jnp.where(do_alloc, base, overlay.data[idx])
+    data = overlay.data.at[idx].set(new_row)
+    pfns = overlay.pfn.at[idx].set(
+        jnp.where(do_alloc, pfn, overlay.pfn[idx]).astype(jnp.int32)
+    )
+    count = overlay.count + do_alloc.astype(jnp.int32)
+    overflow = overlay.overflow | (enabled & ~hit & ~can_alloc & in_range)
+
+    ok = (hit | do_alloc) & in_range
+    return DirtyOverlay(pfns, data, count, overflow), idx, ok
+
+
+def gather_bytes(
+    image: MemImage,
+    overlay: DirtyOverlay,
+    gpa_vec: jax.Array,   # uint64[size]: per-byte physical address
+    first_mask: jax.Array # bool[size]: byte belongs to page of gpa_vec[0]
+) -> jax.Array:
+    """Overlay-aware read of bytes spread over at most two physical pages."""
+    size = gpa_vec.shape[0]
+    pfn0, _ = split_gpa(image, gpa_vec[0])
+    pfn1, _ = split_gpa(image, gpa_vec[size - 1])
+
+    idx0, hit0 = lookup(overlay, pfn0)
+    idx1, hit1 = lookup(overlay, pfn1)
+    slot0 = frame_slot(image, pfn0)
+    slot1 = frame_slot(image, pfn1)
+
+    byte_off = (gpa_vec & jnp.uint64(PAGE_SIZE - 1)).astype(jnp.int32)
+    slot = jnp.where(first_mask, slot0, slot1)
+    row = jnp.where(first_mask, idx0, idx1)
+    use_ov = jnp.where(first_mask, hit0, hit1)
+
+    base_vals = image.pages[slot, byte_off]
+    ov_vals = overlay.data[row, byte_off]
+    return jnp.where(use_ov, ov_vals, base_vals).astype(jnp.uint8)
+
+
+def scatter_bytes(
+    image: MemImage,
+    overlay: DirtyOverlay,
+    gpa_vec: jax.Array,    # uint64[size]
+    first_mask: jax.Array, # bool[size]
+    values: jax.Array,     # uint8[size]
+    enabled: jax.Array,    # bool scalar
+) -> Tuple[DirtyOverlay, jax.Array]:
+    """Overlay-aware write over at most two physical pages -> (overlay', ok).
+
+    Every guest-visible write lands in the overlay and is therefore "dirty"
+    by construction — the device-side counterpart of the reference's
+    `VirtWriteDirty` contract (backend.cc:91-127).
+    """
+    size = gpa_vec.shape[0]
+    pfn0, _ = split_gpa(image, gpa_vec[0])
+    pfn1, _ = split_gpa(image, gpa_vec[size - 1])
+    two_pages = pfn1 != pfn0
+
+    overlay, idx0, ok0 = ensure_page(image, overlay, pfn0, enabled)
+    overlay, idx1, ok1 = ensure_page(image, overlay, pfn1, enabled & two_pages)
+    ok = ok0 & jnp.where(two_pages, ok1, True)
+
+    byte_off = (gpa_vec & jnp.uint64(PAGE_SIZE - 1)).astype(jnp.int32)
+    row = jnp.where(first_mask, idx0, jnp.where(two_pages, idx1, idx0))
+
+    current = overlay.data[row, byte_off]
+    new_vals = jnp.where(enabled & ok, values.astype(jnp.uint8), current)
+    data = overlay.data.at[row, byte_off].set(new_vals)
+    return overlay._replace(data=data), ok
+
+
+def _contiguous_vec(gpa: jax.Array, size: int):
+    offs = jnp.arange(size, dtype=jnp.uint64)
+    gpa_vec = gpa + offs
+    page_off = (gpa & jnp.uint64(PAGE_SIZE - 1)).astype(jnp.int32)
+    first_mask = (page_off + jnp.arange(size, dtype=jnp.int32)) < PAGE_SIZE
+    return gpa_vec, first_mask
+
+
+def phys_read(
+    image: MemImage, overlay: DirtyOverlay, gpa: jax.Array, size: int
+) -> jax.Array:
+    """Contiguous overlay-aware physical read (size <= PAGE_SIZE)."""
+    gpa_vec, first_mask = _contiguous_vec(gpa, size)
+    return gather_bytes(image, overlay, gpa_vec, first_mask)
+
+
+def phys_write(
+    image: MemImage,
+    overlay: DirtyOverlay,
+    gpa: jax.Array,
+    values: jax.Array,
+    enabled: jax.Array,
+) -> Tuple[DirtyOverlay, jax.Array]:
+    """Contiguous overlay-aware physical write (size <= PAGE_SIZE)."""
+    gpa_vec, first_mask = _contiguous_vec(gpa, values.shape[0])
+    return scatter_bytes(image, overlay, gpa_vec, first_mask, values, enabled)
+
+
+def phys_read_u64(image: MemImage, overlay: DirtyOverlay, gpa: jax.Array) -> jax.Array:
+    """Read a little-endian u64 (used for page-table entries; PTEs are
+    8-aligned so this never crosses a page)."""
+    raw = phys_read(image, overlay, gpa, 8)
+    shifts = jnp.arange(8, dtype=jnp.uint64) * 8
+    return jnp.sum(raw.astype(jnp.uint64) << shifts)

@@ -1,0 +1,103 @@
+"""Guest physical memory as an HBM-resident page store.
+
+Replaces the reference's `Ram_t` (reference src/wtf/ram.h:96-152) and the
+backends' demand-paging machinery (bochscpu lazy page handler
+bochscpu_backend.cc:36-138, KVM userfaultfd kvm_backend.cc:2114-2304): on TPU
+the whole snapshot image is uploaded once into HBM as a dense `[slots, 4096]`
+uint8 array shared read-only by every lane, plus an int32 frame table mapping
+guest page-frame-number -> slot.  Slot 0 is a shared zero page; pages absent
+from the dump read as zeros, matching the reference's zero-fill semantics
+(ram.h:249-262).
+
+Guest writes NEVER touch this image — they go to the per-lane dirty overlay
+(wtf_tpu/mem/overlay.py), which is what makes `Restore()` O(1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wtf_tpu.core.gxa import PAGE_SHIFT, PAGE_SIZE
+
+
+class MemImage(NamedTuple):
+    """Device half of PhysMem; broadcast (unmapped) under vmap over lanes."""
+
+    pages: jax.Array       # uint8[slots, PAGE_SIZE]; slot 0 is the zero page
+    frame_table: jax.Array # int32[nframes]; pfn -> slot (0 = absent/zero)
+
+
+@dataclasses.dataclass
+class PhysMem:
+    """Host-side container: builds the device image from a sparse page dict."""
+
+    image: MemImage
+    nframes: int
+    present: np.ndarray  # bool[nframes] — page was present in the dump
+
+    @classmethod
+    def from_pages(cls, pages: Dict[int, bytes], min_frames: int = 16) -> "PhysMem":
+        """Build from {pfn: 4KiB page bytes}.
+
+        Equivalent of `Ram_t::Populate` (ram.h:96-152) — but produces a dense
+        packed array (only pages present in the dump occupy slots) instead of
+        a flat mmap sized to the biggest GPA.
+        """
+        if pages:
+            max_pfn = max(pages)
+        else:
+            max_pfn = 0
+        nframes = max(max_pfn + 1, min_frames)
+
+        pfns = sorted(pages)
+        packed = np.zeros((len(pfns) + 1, PAGE_SIZE), dtype=np.uint8)
+        frame_table = np.zeros(nframes, dtype=np.int32)
+        present = np.zeros(nframes, dtype=bool)
+        for slot, pfn in enumerate(pfns, start=1):
+            data = pages[pfn]
+            if len(data) != PAGE_SIZE:
+                raise ValueError(f"page {pfn:#x} has size {len(data)}")
+            packed[slot] = np.frombuffer(data, dtype=np.uint8)
+            frame_table[pfn] = slot
+            present[pfn] = True
+
+        image = MemImage(
+            pages=jnp.asarray(packed),
+            frame_table=jnp.asarray(frame_table),
+        )
+        return cls(image=image, nframes=nframes, present=present)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.image.pages.size + self.image.frame_table.size * 4)
+
+    def host_read(self, gpa: int, size: int) -> bytes:
+        """Debug/host-side read of the *base* image (no overlay)."""
+        if not hasattr(self, "_host_pages"):
+            # Cache host copies once; the image is immutable after build.
+            self._host_pages = np.asarray(self.image.pages)
+            self._host_table = np.asarray(self.image.frame_table)
+        out = bytearray()
+        pos = gpa
+        end = gpa + size
+        while pos < end:
+            pfn = pos >> PAGE_SHIFT
+            off = pos & (PAGE_SIZE - 1)
+            chunk = min(end - pos, PAGE_SIZE - off)
+            slot = int(self._host_table[pfn]) if pfn < self.nframes else 0
+            out += self._host_pages[slot, off : off + chunk].tobytes()
+            pos += chunk
+        return bytes(out)
+
+
+def frame_slot(image: MemImage, pfn: jax.Array) -> jax.Array:
+    """pfn (int32) -> slot, with out-of-range pfns mapping to the zero page."""
+    nframes = image.frame_table.shape[0]
+    in_range = (pfn >= 0) & (pfn < nframes)
+    safe_pfn = jnp.clip(pfn, 0, nframes - 1)
+    return jnp.where(in_range, image.frame_table[safe_pfn], 0)

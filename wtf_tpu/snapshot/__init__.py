@@ -1,0 +1,2 @@
+from wtf_tpu.snapshot.synthetic import SyntheticSnapshotBuilder
+from wtf_tpu.snapshot.loader import Snapshot, load_snapshot

@@ -1,0 +1,29 @@
+"""Windows kernel crash-dump (mem.dmp) parsing.
+
+Equivalent of the reference's vendored kdmp-parser (reference
+src/libs/kdmp-parser/src/lib/kdmp-parser.h): parses 64-bit full and BMP
+crash dumps into a {pfn: page bytes} mapping.  The fast path is the native
+C++ parser under native/ (ctypes-loaded); this module holds the pure-Python
+fallback and the shared format structs.
+
+Status: implemented by `parse_kdmp` once the native/python parsers land
+(build plan task: native components).  Until then, loading a real mem.dmp
+raises a clear error instead of ModuleNotFoundError.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict
+
+
+def parse_kdmp(path) -> Dict[int, bytes]:
+    """Parse a Windows kernel crash dump into {pfn: 4KiB page}."""
+    header = Path(path).open("rb").read(8)
+    if header != b"PAGEDU64":
+        raise ValueError(f"{path}: not a 64-bit kernel crash dump (bad signature {header!r})")
+    raise NotImplementedError(
+        "mem.dmp parsing is not wired up yet in this build; convert the dump "
+        "with tools to the raw mem.npz format, or wait for the native kdmp "
+        "parser (native/kdmp) to land"
+    )
