@@ -1,0 +1,47 @@
+"""Assemble x86-64 snippets with the system GNU assembler for test vectors.
+
+The reference validates emulation against bochscpu traces of real Windows
+binaries (SURVEY.md §4); we don't ship binaries, so tests assemble their own
+guest code with binutils `as` (Intel syntax) and run it through both
+executors.  Results are cached per-snippet so repeated test runs don't
+re-invoke the toolchain.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import subprocess
+import tempfile
+from functools import lru_cache
+from pathlib import Path
+
+_CACHE_DIR = Path(tempfile.gettempdir()) / "wtf_tpu_asm_cache"
+
+
+@lru_cache(maxsize=None)
+def assemble(source: str) -> bytes:
+    """Assemble Intel-syntax x86-64 `source` into raw machine code bytes."""
+    _CACHE_DIR.mkdir(exist_ok=True)
+    key = hashlib.sha256(source.encode()).hexdigest()[:24]
+    cached = _CACHE_DIR / f"{key}.bin"
+    if cached.exists():
+        return cached.read_bytes()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        obj = tmp / "t.o"
+        binf = tmp / "t.bin"
+        proc = subprocess.run(
+            ["as", "-msyntax=intel", "-mnaked-reg", "-o", str(obj), "--"],
+            input=source.encode(),
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"as failed:\n{proc.stderr.decode()}\nsource:\n{source}")
+        subprocess.run(
+            ["objcopy", "-O", "binary", "--only-section=.text", str(obj), str(binf)],
+            check=True,
+        )
+        code = binf.read_bytes()
+    cached.write_bytes(code)
+    return code

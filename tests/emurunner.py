@@ -1,0 +1,45 @@
+"""Shared helper: build a synthetic guest around an assembled snippet and run
+it on the Python oracle CPU (and later the TPU machine) until `hlt`."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from tests.asmhelper import assemble
+from wtf_tpu.cpu.emu import EmuCpu, EmuMem, GuestCrash
+from wtf_tpu.mem.physmem import PhysMem
+from wtf_tpu.snapshot.synthetic import SyntheticSnapshotBuilder
+
+CODE_BASE = 0x0001_4000_1000
+DATA_BASE = 0x0002_0000_0000
+STACK_TOP = 0x0000_7FFF_F000
+
+
+def build_guest(asm: str, data: Optional[Dict[int, bytes]] = None):
+    """Assemble `asm` at CODE_BASE with a stack and optional data mappings.
+    Returns (PhysMem, CpuState, code bytes)."""
+    code = assemble(asm)
+    b = SyntheticSnapshotBuilder()
+    b.write(CODE_BASE, code)
+    b.map(STACK_TOP - 0x4000, 0x5000)
+    if data:
+        for gva, blob in data.items():
+            b.write(gva, blob)
+    pages, cpu = b.build(rip=CODE_BASE, rsp=STACK_TOP - 0x100)
+    return PhysMem.from_pages(pages), cpu, code
+
+
+def run_emu(asm: str, data: Optional[Dict[int, bytes]] = None,
+            max_steps: int = 100_000, regs: Optional[Dict[str, int]] = None) -> EmuCpu:
+    """Run until hlt (the canonical snippet terminator) or `max_steps`."""
+    physmem, cpustate, _ = build_guest(asm, data)
+    if regs:
+        for name, value in regs.items():
+            setattr(cpustate, name, value)
+    cpu = EmuCpu(EmuMem(physmem), cpustate)
+    for _ in range(max_steps):
+        try:
+            cpu.step()
+        except GuestCrash:
+            return cpu
+    raise AssertionError(f"snippet did not hlt within {max_steps} steps (rip={cpu.rip:#x})")

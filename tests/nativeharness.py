@@ -1,0 +1,114 @@
+"""Run register-only x86-64 snippets on the REAL host CPU as a semantics
+oracle.
+
+The reference's correctness story leans on cross-backend differential runs
+(develop on bochscpu, validate on kvm — SURVEY.md §4.3).  Our analog chain:
+host hardware (this harness) validates the Python oracle (cpu/emu.py), which
+in turn validates the JAX executor.  Snippets used here must only touch
+GPRs/flags and keep the stack balanced — they execute inside the test
+process.
+
+Protocol: a 17×u64 buffer (16 GPRs in encoding order + rflags) is loaded
+into the registers, the snippet runs, registers and flags are captured back.
+rsp (slot 4) is not loaded or compared.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import struct
+import subprocess
+import tempfile
+from functools import lru_cache
+from pathlib import Path
+from typing import List, Tuple
+
+_CACHE_DIR = Path(tempfile.gettempdir()) / "wtf_tpu_native_cache"
+
+_WRAPPER = """
+.intel_syntax noprefix
+.text
+.global snippet_run
+snippet_run:
+    push rbx
+    push rbp
+    push r12
+    push r13
+    push r14
+    push r15
+    push rdi              # keep regs pointer
+    mov rax, [rdi+16*8]   # initial rflags
+    push rax
+    popfq
+    mov rax, [rdi+0*8]
+    mov rcx, [rdi+1*8]
+    mov rdx, [rdi+2*8]
+    mov rbx, [rdi+3*8]
+    mov rbp, [rdi+5*8]
+    mov rsi, [rdi+6*8]
+    mov r8,  [rdi+8*8]
+    mov r9,  [rdi+9*8]
+    mov r10, [rdi+10*8]
+    mov r11, [rdi+11*8]
+    mov r12, [rdi+12*8]
+    mov r13, [rdi+13*8]
+    mov r14, [rdi+14*8]
+    mov r15, [rdi+15*8]
+    mov rdi, [rdi+7*8]
+/* --- snippet --- */
+{snippet}
+/* --- capture --- */
+    xchg rdi, [rsp]       # rdi = regs ptr; [rsp] = snippet's rdi
+    mov [rdi+0*8], rax
+    pushfq
+    pop rax
+    mov [rdi+16*8], rax
+    mov [rdi+1*8], rcx
+    mov [rdi+2*8], rdx
+    mov [rdi+3*8], rbx
+    mov [rdi+5*8], rbp
+    mov [rdi+6*8], rsi
+    pop rax
+    mov [rdi+7*8], rax
+    mov [rdi+8*8],  r8
+    mov [rdi+9*8],  r9
+    mov [rdi+10*8], r10
+    mov [rdi+11*8], r11
+    mov [rdi+12*8], r12
+    mov [rdi+13*8], r13
+    mov [rdi+14*8], r14
+    mov [rdi+15*8], r15
+    pop r15
+    pop r14
+    pop r13
+    pop r12
+    pop rbp
+    pop rbx
+    ret
+"""
+
+
+@lru_cache(maxsize=None)
+def _build(snippet: str) -> str:
+    _CACHE_DIR.mkdir(exist_ok=True)
+    key = hashlib.sha256(snippet.encode()).hexdigest()[:24]
+    sofile = _CACHE_DIR / f"{key}.so"
+    if not sofile.exists():
+        with tempfile.TemporaryDirectory() as tmp:
+            src = Path(tmp) / "snip.S"
+            src.write_text(_WRAPPER.format(snippet=snippet))
+            subprocess.run(
+                ["gcc", "-shared", "-o", str(sofile), str(src)],
+                check=True, capture_output=True,
+            )
+    return str(sofile)
+
+
+def run_native(snippet: str, regs: List[int], rflags: int = 0x202) -> Tuple[List[int], int]:
+    """Execute `snippet` on the host CPU -> (gprs, rflags)."""
+    lib = ctypes.CDLL(_build(snippet))
+    buf = (ctypes.c_uint64 * 17)(*(list(regs) + [rflags]))
+    lib.snippet_run(ctypes.byref(buf))
+    out = list(buf)
+    return out[:16], out[16]

@@ -1,0 +1,497 @@
+"""Oracle-CPU semantics tests.
+
+Two layers (mirroring the reference's cross-backend differential methodology,
+SURVEY.md §4.3):
+  1. hardware-differential: register-only snippets run on the REAL host CPU
+     (tests/nativeharness.py) and on the Python oracle; full GPR+flag compare.
+  2. hand-checked: memory/stack/control-flow/SSE snippets with explicit
+     expected values, run on the oracle inside a synthetic snapshot.
+"""
+
+import pytest
+
+from tests.emurunner import CODE_BASE, DATA_BASE, STACK_TOP, run_emu
+from tests.nativeharness import run_native
+from wtf_tpu.core.cpustate import GPR_NAMES
+from wtf_tpu.core.gxa import PAGE_SIZE
+from wtf_tpu.cpu.decoder import decode
+from wtf_tpu.cpu import uops as U
+from tests.asmhelper import assemble
+
+# rflags bits we compare (TF/IF/reserved excluded)
+FLAGS_MASK = 0x8D5  # CF|PF|AF|ZF|SF|OF
+NO_AF = 0x8C5      # for ops where AF is architecturally undefined
+
+
+# ---------------------------------------------------------------------------
+# 1. hardware-differential tests
+# ---------------------------------------------------------------------------
+
+# (name, snippet, flags_mask) — snippets only touch GPRs/flags, balanced stack.
+HW_CASES = [
+    ("add64", "add rax, rbx", FLAGS_MASK),
+    ("add_neg", "mov rax, -5\nadd rax, 3", FLAGS_MASK),
+    ("adc", "stc\nadc rax, rbx", FLAGS_MASK),
+    ("sub", "sub rcx, rdx", FLAGS_MASK),
+    ("sbb", "stc\nsbb rcx, rdx", FLAGS_MASK),
+    ("cmp", "cmp rsi, rdi", FLAGS_MASK),
+    ("and", "and rax, rbx", NO_AF),
+    ("or", "or rax, r8", NO_AF),
+    ("xor", "xor rdx, r9", NO_AF),
+    ("test", "test r10, r11", NO_AF),
+    ("add32", "add eax, ebx", FLAGS_MASK),
+    ("add16", "add ax, bx", FLAGS_MASK),
+    ("add8", "add al, bl", FLAGS_MASK),
+    ("add8h", "add ah, ch", FLAGS_MASK),
+    ("inc", "inc rax", FLAGS_MASK),
+    ("dec", "dec rbx", FLAGS_MASK),
+    ("inc_preserve_cf", "stc\ninc rax", FLAGS_MASK),
+    ("neg", "neg rcx", FLAGS_MASK),
+    ("neg_zero", "mov rcx, 0\nneg rcx", FLAGS_MASK),
+    ("not", "not rdx", FLAGS_MASK),
+    ("imm8_sx", "add rax, -16", FLAGS_MASK),
+    ("imm32", "add rax, 0x12345678", FLAGS_MASK),
+    ("shl", "shl rax, 5", 0xC5),
+    ("shl1", "shl rax, 1", NO_AF),
+    ("shl_cl", "mov cl, 3\nshl rbx, cl", 0xC5),
+    ("shl_zero_count", "mov cl, 0\nshl rbx, cl", NO_AF),
+    ("shr", "shr rax, 9", 0xC5),
+    ("sar", "sar rax, 4", 0xC5),
+    ("sar32", "sar eax, 31", 0xC5),
+    ("rol", "rol rax, 7", 0x1),
+    ("ror", "ror rbx, 3", 0x1),
+    ("rol1", "rol rax, 1", 0x801),
+    ("rcl", "stc\nrcl rax, 4", 0x1),
+    ("rcr", "rcr rax, 2", 0x1),
+    ("shld", "shld rax, rbx, 11", 0xC5),
+    ("shrd", "shrd rax, rbx, 7", 0xC5),
+    ("mul", "mul rbx", 0x801),          # only CF/OF defined
+    ("mul32", "mul ebx", 0x801),
+    ("mul8", "mul bl", 0x801),
+    ("imul1op", "imul rbx", 0x801),
+    ("imul2op", "imul rax, rbx", 0x801),
+    ("imul3op", "imul rax, rbx, 37", 0x801),
+    ("imul3op8", "imul rax, rbx, -3", 0x801),
+    ("div", "mov rdx, 0\nmov rbx, 7\ndiv rbx", 0),
+    ("div8", "mov ax, 1234\nmov bl, 7\ndiv bl", 0),
+    ("idiv", "mov rax, -100\ncqo\nmov rbx, 7\nidiv rbx", 0),
+    ("cbw", "cbw", 0x8D5),
+    ("cwde", "cwde", FLAGS_MASK),
+    ("cdqe", "cdqe", FLAGS_MASK),
+    ("cqo", "cqo", FLAGS_MASK),
+    ("cdq", "cdq", FLAGS_MASK),
+    ("movzx8", "movzx rax, bl", FLAGS_MASK),
+    ("movzx16", "movzx eax, cx", FLAGS_MASK),
+    ("movsx8", "movsx rax, bl", FLAGS_MASK),
+    ("movsx16", "movsx rax, cx", FLAGS_MASK),
+    ("movsxd", "movsxd rax, ebx", FLAGS_MASK),
+    ("mov_r8_high", "mov ah, bl", FLAGS_MASK),
+    ("mov32_zeroext", "mov eax, ebx", FLAGS_MASK),
+    ("xchg", "xchg rax, rbx", FLAGS_MASK),
+    ("xchg8h", "xchg ah, dl", FLAGS_MASK),
+    ("lea", "lea rax, [rbx + rcx*4 + 0x30]", FLAGS_MASK),
+    ("lea32", "lea eax, [rbx + rdi*2 - 5]", FLAGS_MASK),
+    ("setcc", "cmp rax, rbx\nsete cl\nsetl dl\nsetb r8b", FLAGS_MASK),
+    ("cmov_taken", "cmp rax, rax\ncmove rbx, rcx", FLAGS_MASK),
+    ("cmov_nottaken", "cmp rax, rax\ncmovne rbx, rcx", FLAGS_MASK),
+    ("cmov32_zeroext", "cmp rax, rax\ncmovne ebx, ecx", FLAGS_MASK),
+    ("bt_reg", "bt rax, rbx", 0x1),
+    ("bts_reg", "bts rax, rbx", 0x1),
+    ("btr_reg", "btr rax, 3", 0x1),
+    ("btc_reg", "btc rax, 63", 0x1),
+    ("bsf", "bsf rax, rbx", 0x40),      # ZF only
+    ("bsr", "bsr rax, rbx", 0x40),
+    ("bsf_zero", "xor rbx, rbx\nbsf rax, rbx", 0x40),
+    ("popcnt", "popcnt rax, rbx", 0x8D5),
+    ("tzcnt", "tzcnt rax, rbx", 0x41),
+    ("lzcnt", "lzcnt rax, rbx", 0x41),
+    ("bswap32", "bswap eax", FLAGS_MASK),
+    ("bswap64", "bswap rax", FLAGS_MASK),
+    ("cmpxchg_eq", "mov rax, rbx\ncmpxchg rbx, rcx", FLAGS_MASK),
+    ("cmpxchg_ne", "mov rax, 1\nmov rbx, 2\ncmpxchg rbx, rcx", FLAGS_MASK),
+    ("xadd", "xadd rax, rbx", FLAGS_MASK),
+    ("push_pop", "push rax\npush rbx\npop rcx\npop rdx", FLAGS_MASK),
+    ("pushf_popf", "stc\npushfq\npop rax\nand rax, 1", NO_AF),
+    ("lahf_sahf", "stc\nlahf\nmov cl, ah\nsahf", FLAGS_MASK),
+    ("clc_stc_cmc", "stc\ncmc", FLAGS_MASK),
+    ("cld_std", "std\ncld", FLAGS_MASK),
+    ("flags_chain", "add rax, rbx\nadc rcx, rdx\nsbb rsi, rdi", FLAGS_MASK),
+    # flags depend on the (differing) rsp value — compare registers only
+    ("stack_red", "sub rsp, 32\nmov [rsp], rax\nmov rbx, [rsp]\nadd rsp, 32", 0),
+]
+
+_INIT_REGS = [
+    0x0123456789ABCDEF, 0x0000000000000001, 0xFFFFFFFFFFFFFFFF,
+    0x8000000000000000, 0, 0x00007FFF_00001000, 0x5555555555555555,
+    0xAAAAAAAAAAAAAAAA, 0x7FFFFFFFFFFFFFFF, 0x0000000080000000,
+    0x00000000FFFFFFFF, 0x123, 0xCAFEBABE_DEADBEEF, 0x31, 0x40, 0xFF,
+]
+
+_ALT_REGS = [
+    0xFFFFFFFF80000000, 0x3F, 0x7FFFFFFF, 0xFFFF, 0, 0x10000, 0x2,
+    0xFFFFFFFF00000000, 0x1000000000000000, 0x0F0F0F0F0F0F0F0F,
+    0x8000000000000001, 0x7F, 0x80, 0xFFFE, 0x1F, 0x8642,
+]
+
+
+@pytest.mark.parametrize("name,snippet,fmask",
+                         [(c[0], c[1], c[2]) for c in HW_CASES])
+@pytest.mark.parametrize("initset", ["a", "b"])
+def test_hw_differential(name, snippet, fmask, initset):
+    init = list(_INIT_REGS if initset == "a" else _ALT_REGS)
+    hw_regs, hw_flags = run_native(snippet, init)
+
+    regs = {n: v for n, v in zip(GPR_NAMES, init)}
+    regs.pop("rsp")
+    cpu = run_emu(snippet + "\nhlt", regs=regs)
+
+    for i, gname in enumerate(GPR_NAMES):
+        if gname == "rsp":
+            continue
+        assert cpu.gpr[i] == hw_regs[i], (
+            f"{name}: {gname} emu={cpu.gpr[i]:#x} hw={hw_regs[i]:#x}")
+    assert cpu.rflags & fmask == hw_flags & fmask, (
+        f"{name}: flags emu={cpu.rflags:#x} hw={hw_flags:#x} mask={fmask:#x}")
+
+
+# ---------------------------------------------------------------------------
+# 2. memory / control flow / strings (hand-checked on the oracle)
+# ---------------------------------------------------------------------------
+
+def test_mem_load_store():
+    cpu = run_emu(
+        f"""
+        mov rbx, {DATA_BASE}
+        mov r9, 0x1122334455667788
+        mov [rbx], r9
+        mov eax, [rbx]
+        mov cx, [rbx+6]
+        mov dl, [rbx+7]
+        mov r8, [rbx]
+        hlt
+        """,
+        data={DATA_BASE: b"\x00" * 64},
+    )
+    assert cpu.gpr[0] == 0x55667788
+    assert cpu.gpr[1] & 0xFFFF == 0x1122
+    assert cpu.gpr[2] & 0xFF == 0x11
+    assert cpu.gpr[8] == 0x1122334455667788
+
+
+def test_mem_page_crossing():
+    base = DATA_BASE + PAGE_SIZE - 4
+    cpu = run_emu(
+        f"""
+        mov rbx, {base}
+        mov rax, 0xA1B2C3D4E5F60718
+        mov [rbx], rax
+        mov rcx, [rbx]
+        hlt
+        """,
+        data={DATA_BASE: b"\x00" * (2 * PAGE_SIZE)},
+    )
+    assert cpu.gpr[1] == 0xA1B2C3D4E5F60718
+
+
+def test_rip_relative():
+    cpu = run_emu(
+        """
+        lea rax, [rip + tag]
+        mov rbx, [rip + tag]
+        hlt
+        tag: .quad 0xDEADBEEFCAFEF00D
+        """,
+    )
+    assert cpu.gpr[3] == 0xDEADBEEFCAFEF00D
+    assert cpu.gpr[0] > CODE_BASE
+
+
+def test_call_ret_stack():
+    cpu = run_emu(
+        """
+        call f
+        mov rbx, 7
+        hlt
+        f:
+        mov rax, 42
+        ret
+        """,
+    )
+    assert cpu.gpr[0] == 42
+    assert cpu.gpr[3] == 7
+    assert cpu.gpr[4] == STACK_TOP - 0x100  # balanced
+
+
+def test_loop_fib():
+    cpu = run_emu(
+        """
+        mov rax, 0
+        mov rbx, 1
+        mov rcx, 20
+        l:
+        mov rdx, rax
+        add rdx, rbx
+        mov rax, rbx
+        mov rbx, rdx
+        dec rcx
+        jnz l
+        hlt
+        """,
+    )
+    fib = [0, 1]
+    for _ in range(20):
+        fib.append(fib[-1] + fib[-2])
+    assert cpu.gpr[0] == fib[20]
+
+
+def test_rep_movsb():
+    src = DATA_BASE
+    dst = DATA_BASE + 0x100
+    payload = bytes(range(64))
+    cpu = run_emu(
+        f"""
+        mov rsi, {src}
+        mov rdi, {dst}
+        mov rcx, 64
+        rep movsb
+        hlt
+        """,
+        data={DATA_BASE: payload + b"\x00" * 0x200},
+    )
+    assert cpu.virt_read(dst, 64) == payload
+    assert cpu.gpr[1] == 0
+    assert cpu.gpr[6] == src + 64
+    assert cpu.gpr[7] == dst + 64
+
+
+def test_rep_stosq_and_scasb():
+    cpu = run_emu(
+        f"""
+        mov rdi, {DATA_BASE}
+        mov rax, 0x4141414141414141
+        mov rcx, 8
+        rep stosq
+        mov rdi, {DATA_BASE}
+        mov al, 0x42
+        mov byte ptr [rdi+17], 0x42
+        mov rcx, 64
+        repne scasb
+        hlt
+        """,
+        data={DATA_BASE: b"\x00" * 0x100},
+    )
+    assert cpu.virt_read(DATA_BASE, 8) == b"\x41" * 8
+    # scasb stops after matching index 17 -> rdi = base+18
+    assert cpu.gpr[7] == DATA_BASE + 18
+    assert cpu.gpr[1] == 64 - 18
+
+
+def test_repe_cmpsb():
+    a = DATA_BASE
+    b = DATA_BASE + 0x80
+    blob = b"identical-prefix-X" + b"\x00" * 32
+    blob2 = b"identical-prefix-Y" + b"\x00" * 32
+    cpu = run_emu(
+        f"""
+        mov rsi, {a}
+        mov rdi, {b}
+        mov rcx, 32
+        repe cmpsb
+        hlt
+        """,
+        data={a: blob, b: blob2},
+    )
+    # mismatch at offset 17 ('X' vs 'Y') -> stop after 18 iterations
+    assert cpu.gpr[6] == a + 18
+    assert not cpu.get_flag(0x40)  # ZF clear
+
+
+def test_movs_df_backwards():
+    cpu = run_emu(
+        f"""
+        std
+        mov rsi, {DATA_BASE + 7}
+        mov rdi, {DATA_BASE + 0x47}
+        mov rcx, 8
+        rep movsb
+        cld
+        hlt
+        """,
+        data={DATA_BASE: bytes(range(16)) + b"\x00" * 0x100},
+    )
+    assert cpu.virt_read(DATA_BASE + 0x40, 8) == bytes(range(8))
+
+
+def test_jcc_spectrum():
+    cpu = run_emu(
+        """
+        xor rax, rax
+        mov rbx, 5
+        cmp rbx, 5
+        jne bad
+        je ok1
+        jmp bad
+        ok1:
+        cmp rbx, 9
+        ja bad
+        jb ok2
+        jmp bad
+        ok2:
+        cmp rbx, -1
+        jl bad
+        jg ok3
+        jmp bad
+        ok3:
+        mov rax, 1
+        hlt
+        bad:
+        mov rax, 0xBAD
+        hlt
+        """,
+    )
+    assert cpu.gpr[0] == 1
+
+
+def test_jrcxz():
+    cpu = run_emu(
+        """
+        xor rcx, rcx
+        jrcxz ok
+        mov rax, 0xBAD
+        hlt
+        ok:
+        mov rax, 0x600D
+        hlt
+        """,
+    )
+    assert cpu.gpr[0] == 0x600D
+
+
+def test_push_imm_and_leave():
+    cpu = run_emu(
+        """
+        push rbp
+        mov rbp, rsp
+        sub rsp, 0x20
+        push 0x1234
+        pop rax
+        leave
+        hlt
+        """,
+    )
+    assert cpu.gpr[0] == 0x1234
+    assert cpu.gpr[4] == STACK_TOP - 0x100
+
+
+def test_bt_mem_bitstring():
+    cpu = run_emu(
+        f"""
+        mov rbx, {DATA_BASE}
+        mov rax, 77        # bit 77 = byte 9 bit 5
+        bts [rbx], rax
+        mov rcx, 200
+        bts [rbx], rcx
+        bt  [rbx], rax
+        setc dl
+        hlt
+        """,
+        data={DATA_BASE: b"\x00" * 64},
+    )
+    mem = cpu.virt_read(DATA_BASE, 32)
+    assert mem[9] & (1 << 5)
+    assert mem[25] & (1 << 0)
+    assert cpu.gpr[2] & 0xFF == 1
+
+
+def test_xchg_mem():
+    cpu = run_emu(
+        f"""
+        mov rbx, {DATA_BASE}
+        mov qword ptr [rbx], 0x1111
+        mov rax, 0x2222
+        xchg [rbx], rax
+        hlt
+        """,
+        data={DATA_BASE: b"\x00" * 32},
+    )
+    assert cpu.gpr[0] == 0x1111
+    assert cpu.read_u(DATA_BASE, 8) == 0x2222
+
+
+def test_sse_roundtrip_and_pxor():
+    cpu = run_emu(
+        f"""
+        mov rbx, {DATA_BASE}
+        movdqu xmm0, [rbx]
+        movdqu xmm1, [rbx+16]
+        pxor xmm0, xmm1
+        movdqu [rbx+32], xmm0
+        pcmpeqb xmm1, xmm1
+        pmovmskb eax, xmm1
+        hlt
+        """,
+        data={DATA_BASE: bytes(range(32)) + b"\x00" * 32},
+    )
+    expect = bytes(a ^ b for a, b in zip(range(16), range(16, 32)))
+    assert cpu.virt_read(DATA_BASE + 32, 16) == expect
+    assert cpu.gpr[0] == 0xFFFF
+
+
+def test_sse_movq_movd():
+    cpu = run_emu(
+        """
+        mov rax, 0x1122334455667788
+        movq xmm3, rax
+        movq rbx, xmm3
+        movd ecx, xmm3
+        hlt
+        """,
+    )
+    assert cpu.gpr[3] == 0x1122334455667788
+    assert cpu.gpr[1] == 0x55667788
+
+
+def test_syscall_transition():
+    cpu = run_emu(
+        """
+        mov r10, 0x99
+        syscall
+        hlt
+        .org 0x40
+        mov rax, 0x5CA11
+        hlt
+        """,
+        regs={"lstar": CODE_BASE + 0x40, "sfmask": 0x700},
+    )
+    assert cpu.gpr[0] == 0x5CA11        # landed at lstar
+    assert cpu.gpr[1] == CODE_BASE + len(assemble("mov r10, 0x99\nsyscall"))
+    assert cpu.gpr[11] & 0x2            # r11 = pre-syscall rflags
+
+
+def test_rdrand_deterministic():
+    cpu1 = run_emu("rdrand rax\nrdrand rbx\nhlt")
+    cpu2 = run_emu("rdrand rax\nrdrand rbx\nhlt")
+    assert cpu1.gpr[0] == cpu2.gpr[0]
+    assert cpu1.gpr[3] == cpu2.gpr[3]
+    assert cpu1.gpr[0] != cpu1.gpr[3]
+
+
+def test_cpuid_identity():
+    cpu = run_emu("xor rax, rax\nxor rcx, rcx\ncpuid\nhlt")
+    assert cpu.gpr[0] == 0xD
+    assert cpu.gpr[3] == 0x756E6547  # "Genu"
+
+
+def test_decoder_lengths_cover_stream():
+    """Decode the whole assembled stream instruction-by-instruction: lengths
+    must chain exactly and nothing may decode as INVALID."""
+    src = "\n".join(s for _, s, _ in HW_CASES) + "\nhlt\n"
+    code = assemble(src)
+    pos = 0
+    while pos < len(code):
+        uop = decode(code[pos : pos + 15], pos)
+        assert uop.opc != U.OPC_INVALID, (
+            f"invalid decode at +{pos:#x}: {code[pos:pos+15].hex()}")
+        assert uop.length > 0
+        pos += uop.length
+    assert pos == len(code)

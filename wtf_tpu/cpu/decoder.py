@@ -1,0 +1,1053 @@
+"""Host-side x86-64 instruction decoder: bytes -> Uop.
+
+Runs ONCE per unique guest code address (the decode cache in machine.py keeps
+the result), so it is cold-path and written for clarity, not speed.  Covers
+the long-mode integer subset that compiled Windows/Linux user and kernel code
+actually executes, plus the XMM moves/bitops that show up in memcpy/strlen
+paths; anything outside the subset decodes to OPC_INVALID and surfaces as a
+per-lane UNSUPPORTED status instead of silently corrupting state (mirroring
+how the reference's backends surface unknown situations as explicit results,
+reference src/wtf/backend.h:12-31).
+
+Decoding model: legacy prefixes -> REX -> opcode (1-byte map, 0F map,
+0F 38 map) -> ModRM/SIB/disp -> immediate.  67h address-size and far/segment
+forms are out of scope (never emitted by 64-bit compilers) and decode invalid.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Tuple
+
+from wtf_tpu.cpu.uops import (
+    ALU_ADC, ALU_ADD, ALU_AND, ALU_CMP, ALU_OR, ALU_SBB, ALU_SUB, ALU_TEST,
+    ALU_XOR, BMI_ANDN, BMI_BEXTR, BMI_BLSI, BMI_BLSMSK, BMI_BLSR, BMI_BZHI,
+    BMI_PDEP, BMI_PEXT_, BMI_RORX, BMI_SARX, BMI_SHLX, BMI_SHRX, BS_BSF,
+    BS_BSR, BS_LZCNT, BS_POPCNT, BS_TZCNT, BT_BT, BT_BTC, BT_BTR, BT_BTS,
+    DIV_S, DIV_U, FL_CLC, FL_CLD, FL_CLI, FL_CMC, FL_LAHF, FL_SAHF, FL_STC,
+    FL_STD, FL_STI, K_IMM, K_MEM, K_NONE, K_REG, K_XMM, MUL_2OP, MUL_WIDE_S,
+    MUL_WIDE_U, OPC_ALU, OPC_BITSCAN, OPC_BSWAP, OPC_BT, OPC_CALL,
+    OPC_CMOVCC, OPC_CMPXCHG, OPC_CONVERT, OPC_CPUID, OPC_DIV, OPC_FENCE,
+    OPC_FLAGOP, OPC_HLT, OPC_INT, OPC_INT1, OPC_INVALID, OPC_JCC, OPC_JMP,
+    OPC_LEA, OPC_LEAVE, OPC_MOV, OPC_MOVCR, OPC_MUL, OPC_NOP, OPC_PEXT,
+    OPC_POP, OPC_RDGSBASE,
+    OPC_POPF, OPC_PUSH, OPC_PUSHF, OPC_RDRAND, OPC_RDTSC, OPC_RET,
+    OPC_SETCC, OPC_SHIFT, OPC_SSEALU, OPC_SSEMOV, OPC_STRING, OPC_SYSCALL,
+    OPC_UNARY, OPC_XADD, OPC_XCHG, OPC_XGETBV, REG_AH_BASE, REG_NONE,
+    REG_RIP, REP_NONE, REP_REP, REP_REPNE, SEG_FS, SEG_GS, SEG_NONE,
+    SH_SHL, SH_SHLD, SH_SHRD, SSE_PADDB, SSE_PAND, SSE_PANDN, SSE_PCMPEQB,
+    SSE_PCMPEQD,
+    SSE_PCMPEQW, SSE_PMINUB, SSE_PMOVMSKB, SSE_POR, SSE_PSHUFD, SSE_PSLLDQ,
+    SSE_PSRLDQ, SSE_PSUBB, SSE_PTEST, SSE_PUNPCKLQDQ, SSE_PXOR, SSE_XORPS, STR_CMPS,
+    STR_LODS, STR_MOVS, STR_SCAS, STR_STOS, UN_DEC, UN_INC, UN_NEG, UN_NOT,
+    Uop,
+)
+
+MASK64 = (1 << 64) - 1
+MAX_INSN_LEN = 15
+
+
+class _Cursor:
+    """Byte cursor over the instruction window."""
+
+    def __init__(self, code: bytes):
+        self.code = code
+        self.pos = 0
+
+    def peek(self) -> int:
+        if self.pos >= len(self.code):
+            raise _Truncated()
+        return self.code[self.pos]
+
+    def u8(self) -> int:
+        b = self.peek()
+        self.pos += 1
+        return b
+
+    def bytes(self, n: int) -> bytes:
+        if self.pos + n > len(self.code):
+            raise _Truncated()
+        out = self.code[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def i8(self) -> int:
+        return struct.unpack("<b", self.bytes(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack("<h", self.bytes(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.bytes(4))[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self.bytes(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.bytes(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.bytes(8))[0]
+
+
+class _Truncated(Exception):
+    pass
+
+
+class _Prefixes:
+    def __init__(self):
+        self.osize = False   # 66
+        self.asize = False   # 67
+        self.lock = False    # F0
+        self.repne = False   # F2
+        self.rep = False     # F3
+        self.seg = SEG_NONE
+        self.rex = 0         # 0 = no REX
+
+    @property
+    def rex_w(self) -> bool:
+        return bool(self.rex & 8)
+
+    @property
+    def rex_r(self) -> int:
+        return (self.rex >> 2) & 1
+
+    @property
+    def rex_x(self) -> int:
+        return (self.rex >> 1) & 1
+
+    @property
+    def rex_b(self) -> int:
+        return self.rex & 1
+
+    def opsize(self) -> int:
+        if self.rex_w:
+            return 8
+        if self.osize:
+            return 2
+        return 4
+
+
+def _sx(value: int, bits: int) -> int:
+    """Sign-extend `value` from `bits` to a Python int, then mask to 64."""
+    sign = 1 << (bits - 1)
+    return ((value ^ sign) - sign) & MASK64
+
+
+def _gpr8(idx: int, pfx: _Prefixes) -> int:
+    """8-bit register index: without REX, 4-7 encode ah/ch/dh/bh."""
+    if pfx.rex == 0 and 4 <= idx <= 7:
+        return REG_AH_BASE + (idx - 4)
+    return idx
+
+
+class _ModRM:
+    """Parsed ModRM + SIB + displacement."""
+
+    def __init__(self, cur: _Cursor, pfx: _Prefixes):
+        byte = cur.u8()
+        self.mod = byte >> 6
+        self.reg = ((byte >> 3) & 7) | (pfx.rex_r << 3)
+        rm = byte & 7
+        self.is_mem = self.mod != 3
+        self.rm_reg = rm | (pfx.rex_b << 3)
+        self.base = REG_NONE
+        self.index = REG_NONE
+        self.scale = 1
+        self.disp = 0
+
+        if not self.is_mem:
+            return
+
+        if rm == 4:  # SIB
+            sib = cur.u8()
+            scale_bits = sib >> 6
+            index = ((sib >> 3) & 7) | (pfx.rex_x << 3)
+            base = (sib & 7) | (pfx.rex_b << 3)
+            self.scale = 1 << scale_bits
+            if index != 4:  # rsp can never be an index
+                self.index = index
+            if (base & 7) == 5 and self.mod == 0:
+                self.disp = _sx(cur.u32(), 32)
+            else:
+                self.base = base
+        elif rm == 5 and self.mod == 0:
+            # RIP-relative
+            self.base = REG_RIP
+            self.disp = _sx(cur.u32(), 32)
+            return
+        else:
+            self.base = rm | (pfx.rex_b << 3)
+
+        if self.mod == 1:
+            self.disp = _sx(cur.i8() & 0xFF, 8)
+        elif self.mod == 2:
+            self.disp = _sx(cur.u32(), 32)
+
+
+def _apply_mem(uop: Uop, modrm: _ModRM, pfx: _Prefixes) -> None:
+    uop.base_reg = modrm.base
+    uop.idx_reg = modrm.index
+    uop.scale = modrm.scale
+    uop.disp = modrm.disp
+    uop.seg = pfx.seg
+
+
+def _rm_operand(uop: Uop, modrm: _ModRM, pfx: _Prefixes, is_dst: bool,
+                size8: bool = False) -> None:
+    """Set the r/m side (reg or mem) as dst or src."""
+    if modrm.is_mem:
+        _apply_mem(uop, modrm, pfx)
+        if is_dst:
+            uop.dst_kind = K_MEM
+        else:
+            uop.src_kind = K_MEM
+    else:
+        reg = _gpr8(modrm.rm_reg, pfx) if size8 else modrm.rm_reg
+        if is_dst:
+            uop.dst_kind, uop.dst_reg = K_REG, reg
+        else:
+            uop.src_kind, uop.src_reg = K_REG, reg
+
+
+def _reg_operand(uop: Uop, modrm: _ModRM, pfx: _Prefixes, is_dst: bool,
+                 size8: bool = False) -> None:
+    reg = _gpr8(modrm.reg, pfx) if size8 else modrm.reg
+    if is_dst:
+        uop.dst_kind, uop.dst_reg = K_REG, reg
+    else:
+        uop.src_kind, uop.src_reg = K_REG, reg
+
+
+def _imm_for(uop: Uop, cur: _Cursor, opsize: int, imm8: bool = False) -> None:
+    """Standard immediate: imm8 sign-extended, else imm16/imm32 (imm32
+    sign-extends to 64-bit opsize)."""
+    uop.src_kind = K_IMM
+    if imm8:
+        uop.imm = _sx(cur.u8(), 8)
+    elif opsize == 2:
+        uop.imm = _sx(cur.u16(), 16)
+    else:
+        uop.imm = _sx(cur.u32(), 32)
+
+
+def decode(code: bytes, gva: int = 0) -> Uop:
+    """Decode one instruction from `code` (a window of up to 15 bytes at
+    `gva`).  Always returns a Uop; undecodable input returns OPC_INVALID with
+    length 1 so the executor can flag the lane rather than diverge."""
+    try:
+        uop = _decode_inner(code)
+    except _Truncated:
+        uop = Uop(opc=OPC_INVALID, length=1)
+    except Exception:  # pragma: no cover - decoder bug guard
+        uop = Uop(opc=OPC_INVALID, length=1)
+    uop.raw = code[: uop.length]
+    return uop
+
+
+def _decode_prefixes(cur: _Cursor) -> _Prefixes:
+    pfx = _Prefixes()
+    while True:
+        b = cur.peek()
+        if b == 0x66:
+            pfx.osize = True
+        elif b == 0x67:
+            pfx.asize = True
+        elif b == 0xF0:
+            pfx.lock = True
+        elif b == 0xF2:
+            pfx.repne = True
+        elif b == 0xF3:
+            pfx.rep = True
+        elif b == 0x64:
+            pfx.seg = SEG_FS
+        elif b == 0x65:
+            pfx.seg = SEG_GS
+        elif b in (0x26, 0x2E, 0x36, 0x3E):
+            pass  # es/cs/ss/ds overrides are no-ops in long mode
+        else:
+            break
+        cur.pos += 1
+    b = cur.peek()
+    if 0x40 <= b <= 0x4F:
+        pfx.rex = b & 0xF
+        cur.pos += 1
+    return pfx
+
+
+def _decode_inner(code: bytes) -> Uop:
+    cur = _Cursor(code[:MAX_INSN_LEN])
+    pfx = _decode_prefixes(cur)
+    if pfx.asize:
+        return Uop(opc=OPC_INVALID, length=cur.pos + 1)
+    op = cur.u8()
+    uop = Uop()
+    uop.lock = int(pfx.lock)
+
+    if op == 0x0F:
+        _decode_0f(cur, pfx, uop)
+    else:
+        _decode_primary(op, cur, pfx, uop)
+
+    uop.length = cur.pos
+    return uop
+
+
+# ---------------------------------------------------------------------------
+# Primary (1-byte) opcode map
+# ---------------------------------------------------------------------------
+
+def _decode_primary(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
+    opsize = pfx.opsize()
+
+    # ALU block: 00-3D in groups of 8 per operation
+    if op <= 0x3D and (op & 7) <= 5 and (op >> 3) <= 7:
+        sub = op >> 3
+        form = op & 7
+        uop.opc, uop.sub = OPC_ALU, sub
+        if form == 0:    # op r/m8, r8
+            uop.opsize = 1
+            modrm = _ModRM(cur, pfx)
+            _rm_operand(uop, modrm, pfx, is_dst=True, size8=True)
+            _reg_operand(uop, modrm, pfx, is_dst=False, size8=True)
+        elif form == 1:  # op r/m, r
+            uop.opsize = opsize
+            modrm = _ModRM(cur, pfx)
+            _rm_operand(uop, modrm, pfx, is_dst=True)
+            _reg_operand(uop, modrm, pfx, is_dst=False)
+        elif form == 2:  # op r8, r/m8
+            uop.opsize = 1
+            modrm = _ModRM(cur, pfx)
+            _reg_operand(uop, modrm, pfx, is_dst=True, size8=True)
+            _rm_operand(uop, modrm, pfx, is_dst=False, size8=True)
+        elif form == 3:  # op r, r/m
+            uop.opsize = opsize
+            modrm = _ModRM(cur, pfx)
+            _reg_operand(uop, modrm, pfx, is_dst=True)
+            _rm_operand(uop, modrm, pfx, is_dst=False)
+        elif form == 4:  # op al, imm8
+            uop.opsize = 1
+            uop.dst_kind, uop.dst_reg = K_REG, 0
+            uop.src_kind, uop.imm = K_IMM, _sx(cur.u8(), 8)
+        else:            # op rAX, imm
+            uop.opsize = opsize
+            uop.dst_kind, uop.dst_reg = K_REG, 0
+            _imm_for(uop, cur, opsize)
+        return
+
+    if 0x50 <= op <= 0x57:  # push r64
+        uop.opc = OPC_PUSH
+        uop.opsize = 2 if pfx.osize else 8
+        uop.src_kind, uop.src_reg = K_REG, (op & 7) | (pfx.rex_b << 3)
+        return
+    if 0x58 <= op <= 0x5F:  # pop r64
+        uop.opc = OPC_POP
+        uop.opsize = 2 if pfx.osize else 8
+        uop.dst_kind, uop.dst_reg = K_REG, (op & 7) | (pfx.rex_b << 3)
+        return
+
+    if op == 0x63:  # movsxd r, r/m32
+        uop.opc = OPC_MOV
+        uop.opsize = opsize
+        uop.srcsize, uop.sext = 4, 1
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        return
+
+    if op == 0x68:  # push imm32 (sx to 64)
+        uop.opc = OPC_PUSH
+        uop.opsize = 8
+        uop.src_kind, uop.imm = K_IMM, _sx(cur.u32(), 32)
+        return
+    if op == 0x6A:  # push imm8
+        uop.opc = OPC_PUSH
+        uop.opsize = 8
+        uop.src_kind, uop.imm = K_IMM, _sx(cur.u8(), 8)
+        return
+    if op in (0x69, 0x6B):  # imul r, r/m, imm
+        uop.opc, uop.sub = OPC_MUL, MUL_2OP
+        uop.opsize = opsize
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        # the r/m is the multiplicand; the immediate is the multiplier
+        if op == 0x69:
+            uop.imm = _sx(cur.u32() if opsize != 2 else cur.u16(),
+                          32 if opsize != 2 else 16)
+        else:
+            uop.imm = _sx(cur.u8(), 8)
+        # mark the 3-operand form: src2 = imm (exec checks sub+has imm flag)
+        uop.sext = 2  # sentinel: "imm is second source"
+        return
+
+    if 0x70 <= op <= 0x7F:  # jcc rel8
+        uop.opc, uop.cond = OPC_JCC, op & 0xF
+        uop.opsize = 8
+        uop.imm = _sx(cur.u8(), 8)
+        return
+
+    if op in (0x80, 0x81, 0x83):  # group 1
+        modrm = _ModRM(cur, pfx)
+        uop.opc, uop.sub = OPC_ALU, modrm.reg & 7
+        if op == 0x80:
+            uop.opsize = 1
+            _rm_operand(uop, modrm, pfx, is_dst=True, size8=True)
+            uop.src_kind, uop.imm = K_IMM, _sx(cur.u8(), 8)
+        else:
+            uop.opsize = opsize
+            _rm_operand(uop, modrm, pfx, is_dst=True)
+            _imm_for(uop, cur, opsize, imm8=(op == 0x83))
+        return
+
+    if op in (0x84, 0x85):  # test r/m, r
+        uop.opc, uop.sub = OPC_ALU, ALU_TEST
+        size8 = op == 0x84
+        uop.opsize = 1 if size8 else opsize
+        modrm = _ModRM(cur, pfx)
+        _rm_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+        _reg_operand(uop, modrm, pfx, is_dst=False, size8=size8)
+        return
+
+    if op in (0x86, 0x87):  # xchg r/m, r
+        uop.opc = OPC_XCHG
+        size8 = op == 0x86
+        uop.opsize = 1 if size8 else opsize
+        modrm = _ModRM(cur, pfx)
+        _rm_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+        _reg_operand(uop, modrm, pfx, is_dst=False, size8=size8)
+        return
+
+    if op in (0x88, 0x89, 0x8A, 0x8B):  # mov
+        uop.opc = OPC_MOV
+        size8 = op in (0x88, 0x8A)
+        to_rm = op in (0x88, 0x89)
+        uop.opsize = 1 if size8 else opsize
+        modrm = _ModRM(cur, pfx)
+        if to_rm:
+            _rm_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+            _reg_operand(uop, modrm, pfx, is_dst=False, size8=size8)
+        else:
+            _reg_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+            _rm_operand(uop, modrm, pfx, is_dst=False, size8=size8)
+        return
+
+    if op == 0x8D:  # lea
+        uop.opc = OPC_LEA
+        uop.opsize = opsize
+        modrm = _ModRM(cur, pfx)
+        if not modrm.is_mem:
+            uop.opc = OPC_INVALID
+            return
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _apply_mem(uop, modrm, pfx)
+        uop.seg = SEG_NONE  # lea ignores segment bases
+        return
+
+    if op == 0x8F:  # pop r/m
+        uop.opc = OPC_POP
+        uop.opsize = 2 if pfx.osize else 8
+        modrm = _ModRM(cur, pfx)
+        _rm_operand(uop, modrm, pfx, is_dst=True)
+        return
+
+    if op == 0x90:
+        # nop (also F3 90 = pause)
+        uop.opc = OPC_NOP
+        return
+    if 0x91 <= op <= 0x97:  # xchg rAX, r
+        uop.opc = OPC_XCHG
+        uop.opsize = opsize
+        uop.dst_kind, uop.dst_reg = K_REG, (op & 7) | (pfx.rex_b << 3)
+        uop.src_kind, uop.src_reg = K_REG, 0
+        return
+
+    if op == 0x98:  # cbw/cwde/cdqe
+        uop.opc, uop.sub = OPC_CONVERT, 0
+        uop.opsize = opsize
+        return
+    if op == 0x99:  # cwd/cdq/cqo
+        uop.opc, uop.sub = OPC_CONVERT, 1
+        uop.opsize = opsize
+        return
+
+    if op == 0x9C:
+        uop.opc, uop.opsize = OPC_PUSHF, 8
+        return
+    if op == 0x9D:
+        uop.opc, uop.opsize = OPC_POPF, 8
+        return
+    if op == 0x9E:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_SAHF
+        return
+    if op == 0x9F:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_LAHF
+        return
+
+    if op in (0xA8, 0xA9):  # test al/rAX, imm
+        uop.opc, uop.sub = OPC_ALU, ALU_TEST
+        uop.dst_kind, uop.dst_reg = K_REG, 0
+        if op == 0xA8:
+            uop.opsize = 1
+            uop.src_kind, uop.imm = K_IMM, _sx(cur.u8(), 8)
+        else:
+            uop.opsize = opsize
+            _imm_for(uop, cur, opsize)
+        return
+
+    if op in (0xA4, 0xA5, 0xA6, 0xA7, 0xAA, 0xAB, 0xAC, 0xAD, 0xAE, 0xAF):
+        table = {
+            0xA4: (STR_MOVS, 1), 0xA5: (STR_MOVS, opsize),
+            0xA6: (STR_CMPS, 1), 0xA7: (STR_CMPS, opsize),
+            0xAA: (STR_STOS, 1), 0xAB: (STR_STOS, opsize),
+            0xAC: (STR_LODS, 1), 0xAD: (STR_LODS, opsize),
+            0xAE: (STR_SCAS, 1), 0xAF: (STR_SCAS, opsize),
+        }
+        uop.opc = OPC_STRING
+        uop.sub, uop.opsize = table[op]
+        if pfx.rep:
+            uop.rep = REP_REP
+        elif pfx.repne:
+            uop.rep = REP_REPNE
+        return
+
+    if 0xB0 <= op <= 0xB7:  # mov r8, imm8
+        uop.opc = OPC_MOV
+        uop.opsize = 1
+        uop.dst_kind = K_REG
+        uop.dst_reg = _gpr8((op & 7) | (pfx.rex_b << 3), pfx) \
+            if pfx.rex == 0 else (op & 7) | (pfx.rex_b << 3)
+        uop.src_kind, uop.imm = K_IMM, cur.u8()
+        return
+    if 0xB8 <= op <= 0xBF:  # mov r, imm(16/32/64)
+        uop.opc = OPC_MOV
+        uop.opsize = opsize
+        uop.dst_kind, uop.dst_reg = K_REG, (op & 7) | (pfx.rex_b << 3)
+        uop.src_kind = K_IMM
+        if opsize == 8:
+            uop.imm = cur.u64()
+        elif opsize == 2:
+            uop.imm = cur.u16()
+        else:
+            uop.imm = cur.u32()
+        return
+
+    if op in (0xC0, 0xC1, 0xD0, 0xD1, 0xD2, 0xD3):  # shift group 2
+        modrm = _ModRM(cur, pfx)
+        uop.opc, uop.sub = OPC_SHIFT, modrm.reg & 7
+        size8 = op in (0xC0, 0xD0, 0xD2)
+        uop.opsize = 1 if size8 else opsize
+        _rm_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+        if op in (0xC0, 0xC1):
+            uop.src_kind, uop.imm = K_IMM, cur.u8()
+        elif op in (0xD0, 0xD1):
+            uop.src_kind, uop.imm = K_IMM, 1
+        else:  # D2/D3: count in cl
+            uop.src_kind, uop.src_reg = K_REG, 1
+            uop.srcsize = 1
+        return
+
+    if op == 0xC2:  # ret imm16
+        uop.opc, uop.opsize = OPC_RET, 8
+        uop.imm = cur.u16()
+        return
+    if op == 0xC3:
+        uop.opc, uop.opsize = OPC_RET, 8
+        return
+
+    if op in (0xC6, 0xC7):  # mov r/m, imm
+        modrm = _ModRM(cur, pfx)
+        if modrm.reg & 7 != 0:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc = OPC_MOV
+        if op == 0xC6:
+            uop.opsize = 1
+            _rm_operand(uop, modrm, pfx, is_dst=True, size8=True)
+            uop.src_kind, uop.imm = K_IMM, cur.u8()
+        else:
+            uop.opsize = opsize
+            _rm_operand(uop, modrm, pfx, is_dst=True)
+            _imm_for(uop, cur, opsize)
+        return
+
+    if op == 0xC9:
+        uop.opc, uop.opsize = OPC_LEAVE, 8
+        return
+
+    if op == 0xCC:  # int3
+        uop.opc, uop.sub = OPC_INT, 3
+        return
+    if op == 0xCD:  # int imm8
+        uop.opc, uop.sub = OPC_INT, cur.u8()
+        return
+
+    if op == 0xE3:  # jrcxz
+        uop.opc, uop.cond = OPC_JCC, 16  # special cond: rcx == 0
+        uop.opsize = 8
+        uop.imm = _sx(cur.u8(), 8)
+        return
+
+    if op == 0xE8:  # call rel32
+        uop.opc, uop.opsize = OPC_CALL, 8
+        uop.src_kind, uop.imm = K_IMM, _sx(cur.u32(), 32)
+        return
+    if op == 0xE9:
+        uop.opc, uop.opsize = OPC_JMP, 8
+        uop.src_kind, uop.imm = K_IMM, _sx(cur.u32(), 32)
+        return
+    if op == 0xEB:
+        uop.opc, uop.opsize = OPC_JMP, 8
+        uop.src_kind, uop.imm = K_IMM, _sx(cur.u8(), 8)
+        return
+
+    if op == 0xF4:
+        uop.opc = OPC_HLT
+        return
+    if op == 0xF5:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_CMC
+        return
+
+    if op in (0xF6, 0xF7):  # group 3
+        modrm = _ModRM(cur, pfx)
+        sub = modrm.reg & 7
+        size8 = op == 0xF6
+        size = 1 if size8 else pfx.opsize()
+        if sub in (0, 1):  # test r/m, imm
+            uop.opc, uop.sub = OPC_ALU, ALU_TEST
+            uop.opsize = size
+            _rm_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+            if size8:
+                uop.src_kind, uop.imm = K_IMM, _sx(cur.u8(), 8)
+            else:
+                _imm_for(uop, cur, size)
+        elif sub in (2, 3):  # not / neg
+            uop.opc = OPC_UNARY
+            uop.sub = UN_NOT if sub == 2 else UN_NEG
+            uop.opsize = size
+            _rm_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+        elif sub in (4, 5):  # mul / imul (widening)
+            uop.opc = OPC_MUL
+            uop.sub = MUL_WIDE_U if sub == 4 else MUL_WIDE_S
+            uop.opsize = size
+            _rm_operand(uop, modrm, pfx, is_dst=False, size8=size8)
+        else:  # div / idiv
+            uop.opc = OPC_DIV
+            uop.sub = DIV_U if sub == 6 else DIV_S
+            uop.opsize = size
+            _rm_operand(uop, modrm, pfx, is_dst=False, size8=size8)
+        return
+
+    if op == 0xF8:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_CLC
+        return
+    if op == 0xF9:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_STC
+        return
+    if op == 0xFA:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_CLI
+        return
+    if op == 0xFB:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_STI
+        return
+    if op == 0xFC:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_CLD
+        return
+    if op == 0xFD:
+        uop.opc, uop.sub = OPC_FLAGOP, FL_STD
+        return
+
+    if op == 0xFE:  # group 4: inc/dec r/m8
+        modrm = _ModRM(cur, pfx)
+        sub = modrm.reg & 7
+        if sub > 1:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc = OPC_UNARY
+        uop.sub = UN_INC if sub == 0 else UN_DEC
+        uop.opsize = 1
+        _rm_operand(uop, modrm, pfx, is_dst=True, size8=True)
+        return
+
+    if op == 0xFF:  # group 5
+        modrm = _ModRM(cur, pfx)
+        sub = modrm.reg & 7
+        if sub == 0 or sub == 1:
+            uop.opc = OPC_UNARY
+            uop.sub = UN_INC if sub == 0 else UN_DEC
+            uop.opsize = pfx.opsize()
+            _rm_operand(uop, modrm, pfx, is_dst=True)
+        elif sub == 2:  # call r/m64
+            uop.opc, uop.opsize = OPC_CALL, 8
+            _rm_operand(uop, modrm, pfx, is_dst=False)
+        elif sub == 4:  # jmp r/m64
+            uop.opc, uop.opsize = OPC_JMP, 8
+            _rm_operand(uop, modrm, pfx, is_dst=False)
+        elif sub == 6:  # push r/m64
+            uop.opc = OPC_PUSH
+            uop.opsize = 2 if pfx.osize else 8
+            _rm_operand(uop, modrm, pfx, is_dst=False)
+        else:
+            uop.opc = OPC_INVALID
+        return
+
+    uop.opc = OPC_INVALID
+
+
+# ---------------------------------------------------------------------------
+# 0F (two-byte) opcode map
+# ---------------------------------------------------------------------------
+
+def _decode_0f(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
+    op = cur.u8()
+    opsize = pfx.opsize()
+
+    if op == 0x38:
+        _decode_0f38(cur, pfx, uop)
+        return
+
+    if op == 0x05:
+        uop.opc = OPC_SYSCALL
+        return
+    if op == 0x0B:  # ud2
+        uop.opc, uop.sub = OPC_INT, 6  # #UD
+        return
+    if op == 0x01:
+        b = cur.u8()
+        if b == 0xD0:       # xgetbv
+            uop.opc = OPC_XGETBV
+        elif b == 0xF8:     # swapgs
+            uop.opc, uop.sub = OPC_RDGSBASE, 4
+        else:
+            uop.opc = OPC_INVALID
+        return
+    if op == 0x07:  # sysret
+        uop.opc, uop.sub = OPC_SYSCALL, 1
+        return
+    if op in (0x20, 0x22):  # mov r64, crN / mov crN, r64
+        modrm = _ModRM(cur, pfx)
+        uop.opc = OPC_MOVCR
+        uop.opsize = 8
+        uop.sub = modrm.reg  # control register number (incl. REX.R for cr8)
+        if op == 0x20:
+            uop.dst_kind, uop.dst_reg = K_REG, modrm.rm_reg
+            uop.sext = 0  # read from cr
+        else:
+            uop.src_kind, uop.src_reg = K_REG, modrm.rm_reg
+            uop.sext = 1  # write to cr
+        return
+    if op == 0x0D:  # prefetchw
+        _ModRM(cur, pfx)
+        uop.opc = OPC_NOP
+        return
+    if op in (0x18, 0x19, 0x1A, 0x1B, 0x1C, 0x1D, 0x1E, 0x1F):
+        # hint nop / multi-byte nop with modrm
+        _ModRM(cur, pfx)
+        uop.opc = OPC_NOP
+        return
+
+    if op == 0x31:
+        uop.opc = OPC_RDTSC
+        return
+    if op == 0xA2:
+        uop.opc = OPC_CPUID
+        return
+
+    if 0x40 <= op <= 0x4F:  # cmovcc
+        uop.opc, uop.cond = OPC_CMOVCC, op & 0xF
+        uop.opsize = opsize
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        return
+
+    if 0x80 <= op <= 0x8F:  # jcc rel32
+        uop.opc, uop.cond = OPC_JCC, op & 0xF
+        uop.opsize = 8
+        uop.imm = _sx(cur.u32(), 32)
+        return
+
+    if 0x90 <= op <= 0x9F:  # setcc r/m8
+        uop.opc, uop.cond = OPC_SETCC, op & 0xF
+        uop.opsize = 1
+        modrm = _ModRM(cur, pfx)
+        _rm_operand(uop, modrm, pfx, is_dst=True, size8=True)
+        return
+
+    if op in (0xA3, 0xAB, 0xB3, 0xBB):  # bt/bts/btr/btc r/m, r
+        subs = {0xA3: BT_BT, 0xAB: BT_BTS, 0xB3: BT_BTR, 0xBB: BT_BTC}
+        uop.opc, uop.sub = OPC_BT, subs[op]
+        uop.opsize = opsize
+        modrm = _ModRM(cur, pfx)
+        _rm_operand(uop, modrm, pfx, is_dst=True)
+        _reg_operand(uop, modrm, pfx, is_dst=False)
+        return
+    if op == 0xBA:  # group 8: bt r/m, imm8
+        modrm = _ModRM(cur, pfx)
+        sub = modrm.reg & 7
+        if sub < 4:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc, uop.sub = OPC_BT, sub - 4
+        uop.opsize = opsize
+        _rm_operand(uop, modrm, pfx, is_dst=True)
+        uop.src_kind, uop.imm = K_IMM, cur.u8()
+        return
+
+    if op in (0xA4, 0xA5, 0xAC, 0xAD):  # shld/shrd
+        uop.opc = OPC_SHIFT
+        uop.sub = SH_SHLD if op in (0xA4, 0xA5) else SH_SHRD
+        uop.opsize = opsize
+        modrm = _ModRM(cur, pfx)
+        _rm_operand(uop, modrm, pfx, is_dst=True)
+        _reg_operand(uop, modrm, pfx, is_dst=False)
+        if op in (0xA4, 0xAC):
+            uop.imm = cur.u8()
+            uop.sext = 3  # sentinel: count in imm
+        else:
+            uop.sext = 4  # sentinel: count in cl
+        return
+
+    if op == 0xAE:
+        # group 15: fences are nops; ldmxcsr/stmxcsr unsupported-but-harmless
+        modrm = _ModRM(cur, pfx)
+        sub = modrm.reg & 7
+        if not modrm.is_mem and sub in (5, 6, 7):  # lfence/mfence/sfence
+            uop.opc = OPC_FENCE
+        elif modrm.is_mem and sub in (2, 3):  # ldmxcsr/stmxcsr
+            uop.opc = OPC_NOP
+        else:
+            uop.opc = OPC_INVALID
+        return
+
+    if op == 0xAF:  # imul r, r/m
+        uop.opc, uop.sub = OPC_MUL, MUL_2OP
+        uop.opsize = opsize
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        return
+
+    if op in (0xB0, 0xB1):  # cmpxchg
+        uop.opc = OPC_CMPXCHG
+        size8 = op == 0xB0
+        uop.opsize = 1 if size8 else opsize
+        modrm = _ModRM(cur, pfx)
+        _rm_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+        _reg_operand(uop, modrm, pfx, is_dst=False, size8=size8)
+        return
+
+    if op in (0xB6, 0xB7, 0xBE, 0xBF):  # movzx / movsx
+        uop.opc = OPC_MOV
+        uop.opsize = opsize
+        uop.srcsize = 1 if op in (0xB6, 0xBE) else 2
+        uop.sext = 1 if op in (0xBE, 0xBF) else 0
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False, size8=(uop.srcsize == 1))
+        return
+
+    if op in (0xBC, 0xBD):  # bsf/bsr (F3: tzcnt/lzcnt)
+        uop.opc = OPC_BITSCAN
+        if pfx.rep:
+            uop.sub = BS_TZCNT if op == 0xBC else BS_LZCNT
+        else:
+            uop.sub = BS_BSF if op == 0xBC else BS_BSR
+        uop.opsize = opsize
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        return
+
+    if op == 0xB8 and pfx.rep:  # popcnt
+        uop.opc, uop.sub = OPC_BITSCAN, BS_POPCNT
+        uop.opsize = opsize
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        return
+
+    if op in (0xC0, 0xC1):  # xadd
+        uop.opc = OPC_XADD
+        size8 = op == 0xC0
+        uop.opsize = 1 if size8 else opsize
+        modrm = _ModRM(cur, pfx)
+        _rm_operand(uop, modrm, pfx, is_dst=True, size8=size8)
+        _reg_operand(uop, modrm, pfx, is_dst=False, size8=size8)
+        return
+
+    if op == 0xC7:  # group 9: rdrand / rdseed (/6, /7 reg forms)
+        modrm = _ModRM(cur, pfx)
+        sub = modrm.reg & 7
+        if not modrm.is_mem and sub in (6, 7):
+            uop.opc = OPC_RDRAND
+            uop.opsize = opsize
+            uop.dst_kind, uop.dst_reg = K_REG, modrm.rm_reg
+        else:
+            uop.opc = OPC_INVALID  # cmpxchg16b unsupported for now
+        return
+
+    if 0xC8 <= op <= 0xCF:  # bswap
+        uop.opc = OPC_BSWAP
+        uop.opsize = 8 if pfx.rex_w else 4
+        uop.dst_kind, uop.dst_reg = K_REG, (op & 7) | (pfx.rex_b << 3)
+        return
+
+    _decode_0f_sse(op, cur, pfx, uop)
+
+
+def _decode_0f_sse(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
+    """XMM data movement + bitwise ops (the subset memcpy/strcmp-style code
+    uses).  dst/src kind K_XMM means the register index refers to xmm0-15."""
+
+    def xmm_rm(modrm: _ModRM, is_dst: bool) -> None:
+        if modrm.is_mem:
+            _apply_mem(uop, modrm, pfx)
+            if is_dst:
+                uop.dst_kind = K_MEM
+            else:
+                uop.src_kind = K_MEM
+        else:
+            if is_dst:
+                uop.dst_kind, uop.dst_reg = K_XMM, modrm.rm_reg
+            else:
+                uop.src_kind, uop.src_reg = K_XMM, modrm.rm_reg
+
+    def xmm_reg(modrm: _ModRM, is_dst: bool) -> None:
+        if is_dst:
+            uop.dst_kind, uop.dst_reg = K_XMM, modrm.reg
+        else:
+            uop.src_kind, uop.src_reg = K_XMM, modrm.reg
+
+    # movups/movupd/movss/movsd and movaps/movapd (alignment not enforced)
+    if op in (0x10, 0x28):
+        uop.opc = OPC_SSEMOV
+        uop.opsize = 16
+        if op == 0x10 and pfx.rep:
+            uop.opsize = 4    # movss
+        elif op == 0x10 and pfx.repne:
+            uop.opsize = 8    # movsd
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        return
+    if op in (0x11, 0x29):
+        uop.opc = OPC_SSEMOV
+        uop.opsize = 16
+        if op == 0x11 and pfx.rep:
+            uop.opsize = 4
+        elif op == 0x11 and pfx.repne:
+            uop.opsize = 8
+        modrm = _ModRM(cur, pfx)
+        xmm_rm(modrm, is_dst=True)
+        xmm_reg(modrm, is_dst=False)
+        return
+
+    if op in (0x6F, 0x7F):  # movdqa/movdqu (66 / F3)
+        uop.opc = OPC_SSEMOV
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        if op == 0x6F:
+            xmm_reg(modrm, is_dst=True)
+            xmm_rm(modrm, is_dst=False)
+        else:
+            xmm_rm(modrm, is_dst=True)
+            xmm_reg(modrm, is_dst=False)
+        return
+
+    if op == 0x6E:  # movd/movq xmm, r/m
+        uop.opc = OPC_SSEMOV
+        uop.opsize = 8 if pfx.rex_w else 4
+        uop.sub = 1  # gpr->xmm (zero upper)
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        return
+    if op == 0x7E:
+        uop.opc = OPC_SSEMOV
+        modrm = _ModRM(cur, pfx)
+        if pfx.rep:  # movq xmm, xmm/m64 (zeroes the upper lane, unlike movsd)
+            uop.opsize = 8
+            uop.sub = 3
+            xmm_reg(modrm, is_dst=True)
+            xmm_rm(modrm, is_dst=False)
+        else:  # movd/movq r/m, xmm
+            uop.opsize = 8 if pfx.rex_w else 4
+            uop.sub = 2  # xmm->gpr
+            _rm_operand(uop, modrm, pfx, is_dst=True)
+            xmm_reg(modrm, is_dst=False)
+        return
+    if op == 0xD6:  # movq xmm/m64, xmm (zeroes upper when dst is a register)
+        uop.opc = OPC_SSEMOV
+        uop.opsize = 8
+        uop.sub = 3
+        modrm = _ModRM(cur, pfx)
+        xmm_rm(modrm, is_dst=True)
+        xmm_reg(modrm, is_dst=False)
+        return
+
+    sse_table = {
+        0x57: SSE_XORPS, 0xEF: SSE_PXOR, 0xEB: SSE_POR, 0xDB: SSE_PAND,
+        0xDF: SSE_PANDN, 0x74: SSE_PCMPEQB, 0x75: SSE_PCMPEQW,
+        0x76: SSE_PCMPEQD, 0xF8: SSE_PSUBB, 0xFC: SSE_PADDB,
+        0xDA: SSE_PMINUB, 0x6C: SSE_PUNPCKLQDQ,
+    }
+    if op in sse_table:
+        uop.opc, uop.sub = OPC_SSEALU, sse_table[op]
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        return
+
+    if op == 0xD7:  # pmovmskb r, xmm
+        uop.opc, uop.sub = OPC_SSEALU, SSE_PMOVMSKB
+        uop.opsize = 4
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        if modrm.is_mem:
+            uop.opc = OPC_INVALID
+            return
+        uop.src_kind, uop.src_reg = K_XMM, modrm.rm_reg
+        return
+
+    if op == 0x70 and pfx.osize:  # pshufd xmm, xmm/m128, imm8
+        uop.opc, uop.sub = OPC_SSEALU, SSE_PSHUFD
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        uop.imm = cur.u8()
+        return
+
+    if op == 0x73 and pfx.osize:  # group 14: pslldq/psrldq imm8
+        modrm = _ModRM(cur, pfx)
+        sub = modrm.reg & 7
+        if modrm.is_mem or sub not in (3, 7):
+            uop.opc = OPC_INVALID
+            return
+        uop.opc = OPC_SSEALU
+        uop.sub = SSE_PSLLDQ if sub == 7 else SSE_PSRLDQ
+        uop.opsize = 16
+        uop.dst_kind, uop.dst_reg = K_XMM, modrm.rm_reg
+        uop.src_kind, uop.imm = K_IMM, cur.u8()
+        return
+
+    uop.opc = OPC_INVALID
+
+
+def _decode_0f38(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
+    op = cur.u8()
+    if op == 0x17 and pfx.osize:  # ptest
+        uop.opc, uop.sub = OPC_SSEALU, SSE_PTEST
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        if modrm.is_mem:
+            _apply_mem(uop, modrm, pfx)
+            uop.src_kind = K_MEM
+        else:
+            uop.src_kind, uop.src_reg = K_XMM, modrm.rm_reg
+        uop.dst_kind, uop.dst_reg = K_XMM, modrm.reg
+        uop.sext = 5  # sentinel: flag-only (no writeback)
+        return
+    uop.opc = OPC_INVALID

@@ -1,0 +1,1061 @@
+"""Pure-Python x86-64 oracle executor over decoded Uops.
+
+Role in the system (SURVEY.md §4): the reference's development workflow
+validates the fast backends against deterministic bochscpu `rip` traces; we
+keep the same methodology with this module as the trace producer.  It shares
+the decoder (cpu/decoder.py) with the device path, so a differential test
+pins down exactly one thing: that the JAX executor (cpu/exec.py) implements
+the same *semantics* for each uop.  It also powers the `emu` execution
+backend (the "fake backend" seam, reference `Backend_t` §2.2) so the whole
+harness/fuzz/distribution plane is testable without a TPU.
+
+Unsupported-instruction policy: raise/flag, never guess — identical to the
+device executor's UNSUPPORTED status.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from wtf_tpu.core.cpustate import (
+    CpuState,
+    RFLAGS_AF, RFLAGS_CF, RFLAGS_DF, RFLAGS_OF, RFLAGS_PF, RFLAGS_SF,
+    RFLAGS_ZF,
+)
+from wtf_tpu.core.gxa import PAGE_SHIFT, PAGE_SIZE
+from wtf_tpu.cpu import uops as U
+from wtf_tpu.cpu.cpuid import cpuid, splitmix64
+from wtf_tpu.cpu.decoder import decode
+from wtf_tpu.mem.physmem import PhysMem
+
+MASK64 = (1 << 64) - 1
+
+PTE_P = 1
+PTE_W = 1 << 1
+PTE_PS = 1 << 7
+PHYS_MASK = 0x000F_FFFF_FFFF_F000
+
+
+class MemFault(Exception):
+    """Unresolvable guest access (non-present / non-canonical / !W write)."""
+
+    def __init__(self, gva: int, write: bool):
+        super().__init__(f"#PF {'write' if write else 'read'} @ {gva:#x}")
+        self.gva = gva
+        self.write = write
+
+
+class DivideError(Exception):
+    pass
+
+
+class UnsupportedInsn(Exception):
+    def __init__(self, rip: int, raw: bytes):
+        super().__init__(f"unsupported instruction @ {rip:#x}: {raw.hex()}")
+        self.rip = rip
+        self.raw = raw
+
+
+class EmuMem:
+    """Overlay-on-snapshot memory, mirroring mem/overlay.py semantics: the
+    base image is immutable; writes copy pages into a dict overlay; reset()
+    is O(dirty)."""
+
+    def __init__(self, physmem: PhysMem):
+        self.phys = physmem
+        self.overlay: Dict[int, bytearray] = {}
+
+    def reset(self) -> None:
+        self.overlay.clear()
+
+    def dirty_pfns(self) -> List[int]:
+        return sorted(self.overlay)
+
+    def _page(self, pfn: int, for_write: bool) -> bytes:
+        if pfn in self.overlay:
+            return self.overlay[pfn]
+        if for_write:
+            base = self.phys.host_read(pfn << PAGE_SHIFT, PAGE_SIZE)
+            page = bytearray(base)
+            self.overlay[pfn] = page
+            return page
+        return self.phys.host_read(pfn << PAGE_SHIFT, PAGE_SIZE)
+
+    def phys_read(self, gpa: int, size: int) -> bytes:
+        out = bytearray()
+        pos = gpa
+        while pos < gpa + size:
+            pfn = pos >> PAGE_SHIFT
+            off = pos & (PAGE_SIZE - 1)
+            chunk = min(gpa + size - pos, PAGE_SIZE - off)
+            page = self._page(pfn, for_write=False)
+            out += page[off : off + chunk]
+            pos += chunk
+        return bytes(out)
+
+    def phys_write(self, gpa: int, data: bytes) -> None:
+        pos = 0
+        while pos < len(data):
+            addr = gpa + pos
+            pfn = addr >> PAGE_SHIFT
+            off = addr & (PAGE_SIZE - 1)
+            chunk = min(len(data) - pos, PAGE_SIZE - off)
+            page = self._page(pfn, for_write=True)
+            page[off : off + chunk] = data[pos : pos + chunk]
+            pos += chunk
+
+    def phys_read_u64(self, gpa: int) -> int:
+        return int.from_bytes(self.phys_read(gpa, 8), "little")
+
+
+def _sx(value: int, bits: int) -> int:
+    sign = 1 << (bits - 1)
+    return ((value ^ sign) - sign)
+
+
+def _parity(value: int) -> bool:
+    return bin(value & 0xFF).count("1") % 2 == 0
+
+
+class EmuCpu:
+    """One guest vCPU interpreting over an EmuMem."""
+
+    def __init__(self, mem: EmuMem, state: CpuState):
+        self.mem = mem
+        self.snapshot = state
+        self.gpr: List[int] = [0] * 16
+        self.xmm: List[List[int]] = [[0, 0] for _ in range(16)]
+        self.rip = 0
+        self.rflags = 0x2
+        self.cr3 = 0
+        self.cr0 = 0
+        self.cr4 = 0
+        self.cr8 = 0
+        self.fs_base = 0
+        self.gs_base = 0
+        self.kernel_gs_base = 0
+        self.lstar = 0
+        self.star = 0
+        self.sfmask = 0
+        self.tsc = 0
+        self.icount = 0
+        self.rdrand_state = 0
+        self.decode_cache: Dict[int, object] = {}
+        # pfn -> rips decoded from that physical page (for SMC/restore flush)
+        self.decode_pages: Dict[int, List[int]] = {}
+        self.load_state(state)
+
+    # -- state ----------------------------------------------------------
+    def load_state(self, state: CpuState) -> None:
+        self.gpr = state.gpr_list()
+        self.rip = state.rip
+        self.rflags = state.rflags | 0x2
+        self.cr3 = state.cr3
+        self.cr0 = state.cr0
+        self.cr4 = state.cr4
+        self.cr8 = state.cr8
+        self.fs_base = state.fs.base
+        self.gs_base = state.gs.base
+        self.kernel_gs_base = state.kernel_gs_base
+        self.lstar = state.lstar
+        self.star = state.star
+        self.sfmask = state.sfmask
+        self.tsc = state.tsc
+        self.icount = 0
+        self.rdrand_state = 0
+        self.cr3_event = None
+        for i in range(16):
+            self.xmm[i] = [state.zmm[i][0], state.zmm[i][1]]
+
+    # -- registers ------------------------------------------------------
+    def read_reg(self, idx: int, size: int) -> int:
+        if idx >= U.REG_AH_BASE:
+            return (self.gpr[idx - U.REG_AH_BASE] >> 8) & 0xFF
+        val = self.gpr[idx]
+        return val & ((1 << (size * 8)) - 1)
+
+    def write_reg(self, idx: int, size: int, value: int) -> None:
+        if idx >= U.REG_AH_BASE:
+            base = idx - U.REG_AH_BASE
+            self.gpr[base] = (self.gpr[base] & ~0xFF00) | ((value & 0xFF) << 8)
+            return
+        if size == 8:
+            self.gpr[idx] = value & MASK64
+        elif size == 4:
+            self.gpr[idx] = value & 0xFFFFFFFF  # 32-bit writes zero-extend
+        else:
+            mask = (1 << (size * 8)) - 1
+            self.gpr[idx] = (self.gpr[idx] & ~mask) | (value & mask)
+
+    # -- translation / memory ------------------------------------------
+    def translate(self, gva: int, write: bool) -> int:
+        """4-level long-mode walk (reference kvm_backend.cc:1937-1998)."""
+        gva &= MASK64
+        top = gva >> 47
+        if top != 0 and top != 0x1FFFF:
+            raise MemFault(gva, write)
+        table = self.cr3 & PHYS_MASK
+        for shift, large_mask in ((39, None), (30, 0x000F_FFFF_C000_0000),
+                                  (21, 0x000F_FFFF_FFE0_0000), (12, None)):
+            index = (gva >> shift) & 0x1FF
+            entry = self.mem.phys_read_u64(table + index * 8)
+            if not entry & PTE_P:
+                raise MemFault(gva, write)
+            if write and not entry & PTE_W:
+                raise MemFault(gva, write)
+            if large_mask is not None and entry & PTE_PS:
+                return (entry & large_mask) | (gva & ((1 << shift) - 1))
+            if shift == 12:
+                return (entry & PHYS_MASK) | (gva & 0xFFF)
+            table = entry & PHYS_MASK
+        raise AssertionError("unreachable")
+
+    def virt_read(self, gva: int, size: int) -> bytes:
+        out = bytearray()
+        pos = gva
+        while pos < gva + size:
+            off = pos & (PAGE_SIZE - 1)
+            chunk = min(gva + size - pos, PAGE_SIZE - off)
+            gpa = self.translate(pos, write=False)
+            out += self.mem.phys_read(gpa, chunk)
+            pos += chunk
+        return bytes(out)
+
+    def virt_write(self, gva: int, data: bytes, enforce: bool = True) -> None:
+        pos = 0
+        while pos < len(data):
+            addr = gva + pos
+            off = addr & (PAGE_SIZE - 1)
+            chunk = min(len(data) - pos, PAGE_SIZE - off)
+            gpa = self.translate(addr, write=enforce)
+            self.mem.phys_write(gpa, data[pos : pos + chunk])
+            pos += chunk
+
+    def read_u(self, gva: int, size: int) -> int:
+        return int.from_bytes(self.virt_read(gva, size), "little")
+
+    def write_u(self, gva: int, size: int, value: int) -> None:
+        self.virt_write(gva, (value & ((1 << (size * 8)) - 1)).to_bytes(size, "little"))
+
+    # -- flags ----------------------------------------------------------
+    def get_flag(self, bit: int) -> bool:
+        return bool(self.rflags & bit)
+
+    def set_flags(self, **kw) -> None:
+        table = {
+            "cf": RFLAGS_CF, "pf": RFLAGS_PF, "af": RFLAGS_AF,
+            "zf": RFLAGS_ZF, "sf": RFLAGS_SF, "of": RFLAGS_OF,
+            "df": RFLAGS_DF,
+        }
+        for name, val in kw.items():
+            bit = table[name]
+            if val:
+                self.rflags |= bit
+            else:
+                self.rflags &= ~bit
+
+    def _flags_logic(self, result: int, bits: int) -> None:
+        mask = (1 << bits) - 1
+        r = result & mask
+        self.set_flags(cf=False, of=False, af=False,
+                       zf=r == 0, sf=bool(r >> (bits - 1)), pf=_parity(r))
+
+    def _flags_add(self, a: int, b: int, r: int, bits: int, carry_in: int = 0) -> None:
+        mask = (1 << bits) - 1
+        full = (a & mask) + (b & mask) + carry_in
+        rm = r & mask
+        self.set_flags(
+            cf=full > mask,
+            af=bool((a ^ b ^ r) & 0x10),
+            zf=rm == 0,
+            sf=bool(rm >> (bits - 1)),
+            of=bool(((a ^ r) & (b ^ r)) >> (bits - 1) & 1),
+            pf=_parity(rm),
+        )
+
+    def _flags_sub(self, a: int, b: int, r: int, bits: int, borrow_in: int = 0) -> None:
+        mask = (1 << bits) - 1
+        self.set_flags(
+            cf=(a & mask) < (b & mask) + borrow_in,
+            af=bool((a ^ b ^ r) & 0x10),
+            zf=(r & mask) == 0,
+            sf=bool((r & mask) >> (bits - 1)),
+            of=bool(((a ^ b) & (a ^ r)) >> (bits - 1) & 1),
+            pf=_parity(r),
+        )
+
+    def eval_cond(self, cc: int) -> bool:
+        f = self.rflags
+        cf, zf = bool(f & RFLAGS_CF), bool(f & RFLAGS_ZF)
+        sf, of = bool(f & RFLAGS_SF), bool(f & RFLAGS_OF)
+        pf = bool(f & RFLAGS_PF)
+        table = [
+            of, not of, cf, not cf, zf, not zf, cf or zf, not (cf or zf),
+            sf, not sf, pf, not pf, sf != of, sf == of,
+            zf or (sf != of), not zf and sf == of,
+        ]
+        if cc == 16:  # jrcxz
+            return self.gpr[1] == 0  # rcx
+        return table[cc]
+
+    # -- addressing -----------------------------------------------------
+    def effective_addr(self, uop: U.Uop, next_rip: int) -> int:
+        addr = uop.disp
+        if uop.base_reg == U.REG_RIP:
+            addr += next_rip
+        elif uop.base_reg != U.REG_NONE:
+            addr += self.gpr[uop.base_reg]
+        if uop.idx_reg != U.REG_NONE:
+            addr += self.gpr[uop.idx_reg] * uop.scale
+        if uop.seg == U.SEG_FS:
+            addr += self.fs_base
+        elif uop.seg == U.SEG_GS:
+            addr += self.gs_base
+        return addr & MASK64
+
+    # -- fetch/decode/execute -------------------------------------------
+    def restore(self, state: Optional[CpuState] = None) -> None:
+        """Per-testcase restore: flush uops decoded from pages this run
+        dirtied (their bytes roll back with the overlay), reset memory, and
+        reload registers.  The cheap path of the reference's
+        `Backend_t::Restore` (SURVEY.md §5.4)."""
+        for pfn in self.mem.dirty_pfns():
+            for rip in self.decode_pages.pop(pfn, ()):
+                self.decode_cache.pop(rip, None)
+        self.mem.reset()
+        self.load_state(state or self.snapshot)
+
+    def fetch_decode(self) -> U.Uop:
+        cached = self.decode_cache.get(self.rip)
+        window = b""
+        if cached is None:
+            window = self._fetch_window()
+            uop = decode(window, self.rip)
+            self.decode_cache[self.rip] = uop
+            try:
+                first = self.translate(self.rip, write=False) >> PAGE_SHIFT
+                last = self.translate(self.rip + max(uop.length - 1, 0),
+                                      write=False) >> PAGE_SHIFT
+                for pfn in {first, last}:
+                    self.decode_pages.setdefault(pfn, []).append(self.rip)
+            except MemFault:
+                pass
+            cached = uop
+        else:
+            # self-modifying-code guard: revalidate raw bytes if either page
+            # the instruction spans is dirty (mirrors the device SMC check)
+            dirty = False
+            try:
+                span = max(len(cached.raw) - 1, 0)
+                for off in {0, span}:
+                    pfn = self.translate(self.rip + off, write=False) >> PAGE_SHIFT
+                    dirty |= pfn in self.mem.overlay
+            except MemFault:
+                pass
+            if dirty:
+                window = self._fetch_window()
+                if not window.startswith(cached.raw):
+                    cached = decode(window, self.rip)
+                    self.decode_cache[self.rip] = cached
+        return cached
+
+    def _fetch_window(self) -> bytes:
+        try:
+            return self.virt_read(self.rip, 15)
+        except MemFault:
+            # near end of mapped region: fetch what we can, byte at a time
+            out = bytearray()
+            for i in range(15):
+                try:
+                    out += self.virt_read(self.rip + i, 1)
+                except MemFault:
+                    break
+            if not out:
+                raise
+            return bytes(out)
+
+    def step(self) -> None:
+        """Execute exactly one instruction (one uop)."""
+        uop = self.fetch_decode()
+        self.execute(uop)
+        self.icount += 1
+
+    def execute(self, uop: U.Uop) -> None:  # noqa: C901 - one big dispatcher
+        opc = uop.opc
+        next_rip = (self.rip + uop.length) & MASK64
+        opsize = uop.opsize
+        bits = opsize * 8
+        mask = (1 << bits) - 1
+
+        if opc == U.OPC_INVALID:
+            raise UnsupportedInsn(self.rip, uop.raw)
+
+        if opc in (U.OPC_NOP, U.OPC_FENCE):
+            self.rip = next_rip
+            return
+
+        # ---- generic source value -------------------------------------
+        ea = None
+        if uop.mem_operand() or opc == U.OPC_LEA:
+            ea = self.effective_addr(uop, next_rip)
+
+        def load_src() -> int:
+            srcsize = uop.srcsize or opsize
+            if uop.src_kind == U.K_REG:
+                val = self.read_reg(uop.src_reg, srcsize)
+            elif uop.src_kind == U.K_MEM:
+                val = self.read_u(ea, srcsize)
+            elif uop.src_kind == U.K_IMM:
+                return uop.imm & mask
+            else:
+                return 0
+            if uop.sext == 1:
+                val = _sx(val, srcsize * 8) & mask
+            else:
+                val &= mask
+            return val
+
+        def load_dst() -> int:
+            if uop.dst_kind == U.K_REG:
+                return self.read_reg(uop.dst_reg, opsize)
+            if uop.dst_kind == U.K_MEM:
+                return self.read_u(ea, opsize)
+            return 0
+
+        def store_dst(value: int) -> None:
+            if uop.dst_kind == U.K_REG:
+                self.write_reg(uop.dst_reg, opsize, value)
+            elif uop.dst_kind == U.K_MEM:
+                self.write_u(ea, opsize, value)
+
+        # ---- dispatch ---------------------------------------------------
+        if opc == U.OPC_MOV:
+            store_dst(load_src())
+        elif opc == U.OPC_LEA:
+            self.write_reg(uop.dst_reg, opsize, ea)
+        elif opc == U.OPC_ALU:
+            self._exec_alu(uop, load_src(), load_dst, store_dst, bits)
+        elif opc == U.OPC_SHIFT:
+            self._exec_shift(uop, load_src, load_dst, store_dst, bits)
+        elif opc == U.OPC_UNARY:
+            self._exec_unary(uop, load_dst, store_dst, bits)
+        elif opc == U.OPC_MUL:
+            self._exec_mul(uop, load_src(), bits)
+        elif opc == U.OPC_DIV:
+            self._exec_div(uop, load_src(), bits)
+        elif opc == U.OPC_PUSH:
+            val = load_src()
+            self.gpr[4] = (self.gpr[4] - opsize) & MASK64
+            self.write_u(self.gpr[4], opsize, val)
+        elif opc == U.OPC_POP:
+            val = self.read_u(self.gpr[4], opsize)
+            self.gpr[4] = (self.gpr[4] + opsize) & MASK64
+            store_dst(val)
+        elif opc == U.OPC_CALL:
+            target = (next_rip + uop.imm) & MASK64 if uop.src_kind == U.K_IMM \
+                else load_src()
+            self.gpr[4] = (self.gpr[4] - 8) & MASK64
+            self.write_u(self.gpr[4], 8, next_rip)
+            self.rip = target
+            return
+        elif opc == U.OPC_RET:
+            self.rip = self.read_u(self.gpr[4], 8)
+            self.gpr[4] = (self.gpr[4] + 8 + uop.imm) & MASK64
+            return
+        elif opc == U.OPC_JMP:
+            self.rip = (next_rip + uop.imm) & MASK64 if uop.src_kind == U.K_IMM \
+                else load_src()
+            return
+        elif opc == U.OPC_JCC:
+            if self.eval_cond(uop.cond):
+                self.rip = (next_rip + uop.imm) & MASK64
+                return
+        elif opc == U.OPC_SETCC:
+            store_dst(1 if self.eval_cond(uop.cond) else 0)
+        elif opc == U.OPC_CMOVCC:
+            value = load_src() if self.eval_cond(uop.cond) else load_dst()
+            store_dst(value)  # always writes (64-bit mode zero-extension)
+        elif opc == U.OPC_STRING:
+            if not self._exec_string(uop, opsize):
+                return  # rip unchanged: more REP iterations pending
+        elif opc == U.OPC_XCHG:
+            a = load_dst()
+            b = load_src()
+            store_dst(b)
+            if uop.src_kind == U.K_REG:
+                self.write_reg(uop.src_reg, opsize, a)
+        elif opc == U.OPC_CONVERT:
+            self._exec_convert(uop, bits)
+        elif opc == U.OPC_BT:
+            self._exec_bt(uop, ea, bits)
+        elif opc == U.OPC_BITSCAN:
+            self._exec_bitscan(uop, load_src(), bits)
+        elif opc == U.OPC_PUSHF:
+            self.gpr[4] = (self.gpr[4] - 8) & MASK64
+            self.write_u(self.gpr[4], 8, self.rflags | 0x2)
+        elif opc == U.OPC_POPF:
+            val = self.read_u(self.gpr[4], 8)
+            self.gpr[4] = (self.gpr[4] + 8) & MASK64
+            settable = 0xFD5 | RFLAGS_DF | 0x100 | 0x200 | (1 << 18)
+            self.rflags = (val & settable) | 0x2
+        elif opc == U.OPC_FLAGOP:
+            self._exec_flagop(uop)
+        elif opc == U.OPC_BSWAP:
+            val = self.read_reg(uop.dst_reg, opsize)
+            self.write_reg(uop.dst_reg, opsize,
+                           int.from_bytes(val.to_bytes(opsize, "little"), "big"))
+        elif opc == U.OPC_CMPXCHG:
+            dst = load_dst()
+            acc = self.read_reg(0, opsize)
+            self._flags_sub(acc, dst, (acc - dst) & mask, bits)
+            if acc == dst:
+                store_dst(self.read_reg(uop.src_reg, opsize))
+            else:
+                # Intel: on failure the destination is still written back
+                store_dst(dst)
+                self.write_reg(0, opsize, dst)
+        elif opc == U.OPC_XADD:
+            dst = load_dst()
+            src = self.read_reg(uop.src_reg, opsize)
+            r = (dst + src) & mask
+            self._flags_add(dst, src, r, bits)
+            self.write_reg(uop.src_reg, opsize, dst)
+            store_dst(r)
+        elif opc == U.OPC_LEAVE:
+            self.gpr[4] = self.gpr[5]
+            self.gpr[5] = self.read_u(self.gpr[4], 8)
+            self.gpr[4] = (self.gpr[4] + 8) & MASK64
+        elif opc == U.OPC_RDTSC:
+            tsc = (self.tsc + self.icount) & MASK64
+            self.write_reg(0, 8, tsc & 0xFFFFFFFF)
+            self.write_reg(2, 8, tsc >> 32)
+        elif opc == U.OPC_RDRAND:
+            self.rdrand_state = splitmix64(self.rdrand_state)
+            store_dst(self.rdrand_state & mask)
+            self.set_flags(cf=True, of=False, af=False, zf=False, sf=False, pf=False)
+        elif opc == U.OPC_CPUID:
+            eax, ebx, ecx, edx = cpuid(self.gpr[0] & 0xFFFFFFFF,
+                                       self.gpr[1] & 0xFFFFFFFF)
+            self.write_reg(0, 4, eax)
+            self.write_reg(3, 4, ebx)
+            self.write_reg(1, 4, ecx)
+            self.write_reg(2, 4, edx)
+        elif opc == U.OPC_XGETBV:
+            self.write_reg(0, 4, 0x7)  # x87+SSE+AVX state enabled
+            self.write_reg(2, 4, 0)
+        elif opc == U.OPC_SYSCALL:
+            if uop.sub == 0:
+                self.gpr[1] = next_rip                       # rcx
+                self.gpr[11] = self.rflags & ~0x10000        # r11 (RF clear)
+                self.rflags = (self.rflags & ~(self.sfmask | 0x100)) | 0x2
+                self.rip = self.lstar
+                return
+            else:  # sysret
+                self.rip = self.gpr[1]
+                self.rflags = (self.gpr[11] & 0x3C7FD7) | 0x2
+                return
+        elif opc == U.OPC_RDGSBASE:
+            if uop.sub == 4:  # swapgs
+                self.gs_base, self.kernel_gs_base = \
+                    self.kernel_gs_base, self.gs_base
+            else:
+                raise UnsupportedInsn(self.rip, uop.raw)
+        elif opc == U.OPC_MOVCR:
+            self._exec_movcr(uop)
+        elif opc == U.OPC_SSEMOV:
+            self._exec_ssemov(uop, ea)
+        elif opc == U.OPC_SSEALU:
+            self._exec_ssealu(uop, ea)
+        elif opc in (U.OPC_INT, U.OPC_HLT, U.OPC_INT1):
+            raise GuestCrash(self.rip, uop)
+        else:
+            raise UnsupportedInsn(self.rip, uop.raw)
+
+        self.rip = next_rip
+
+    # -- op-class helpers ----------------------------------------------
+    def _exec_alu(self, uop, b, load_dst, store_dst, bits) -> None:
+        mask = (1 << bits) - 1
+        a = load_dst()
+        sub = uop.sub
+        if sub == U.ALU_ADD:
+            r = (a + b) & mask
+            self._flags_add(a, b, r, bits)
+            store_dst(r)
+        elif sub == U.ALU_ADC:
+            c = int(self.get_flag(RFLAGS_CF))
+            r = (a + b + c) & mask
+            self._flags_add(a, b, r, bits, carry_in=c)
+            store_dst(r)
+        elif sub == U.ALU_SUB:
+            r = (a - b) & mask
+            self._flags_sub(a, b, r, bits)
+            store_dst(r)
+        elif sub == U.ALU_SBB:
+            c = int(self.get_flag(RFLAGS_CF))
+            r = (a - b - c) & mask
+            self._flags_sub(a, b, r, bits, borrow_in=c)
+            store_dst(r)
+        elif sub == U.ALU_CMP:
+            r = (a - b) & mask
+            self._flags_sub(a, b, r, bits)
+        elif sub == U.ALU_AND:
+            r = a & b
+            self._flags_logic(r, bits)
+            store_dst(r)
+        elif sub == U.ALU_OR:
+            r = a | b
+            self._flags_logic(r, bits)
+            store_dst(r)
+        elif sub == U.ALU_XOR:
+            r = a ^ b
+            self._flags_logic(r, bits)
+            store_dst(r)
+        elif sub == U.ALU_TEST:
+            self._flags_logic(a & b, bits)
+
+    def _exec_shift(self, uop, load_src, load_dst, store_dst, bits) -> None:
+        mask = (1 << bits) - 1
+        a = load_dst()
+        sub = uop.sub
+        if sub in (U.SH_SHLD, U.SH_SHRD):
+            filler = self.read_reg(uop.src_reg, uop.opsize)
+            count = (uop.imm if uop.sext == 3 else self.read_reg(1, 1)) \
+                & (0x3F if bits == 64 else 0x1F)
+            if count == 0:
+                return
+            if count > bits:
+                count %= bits  # 16-bit forms w/ count>16: arch-undefined
+            if sub == U.SH_SHLD:
+                wide = (a << bits) | filler
+                r = (wide >> (bits - count)) & mask
+                cf = bool((a >> (bits - count)) & 1)
+            else:
+                wide = (filler << bits) | a
+                r = (wide >> count) & mask
+                cf = bool((a >> (count - 1)) & 1)
+            self.set_flags(cf=cf, zf=r == 0, sf=bool(r >> (bits - 1)),
+                           pf=_parity(r),
+                           of=bool((r ^ a) >> (bits - 1)) if count == 1 else False)
+            store_dst(r)
+            return
+
+        count_raw = load_src()
+        count = count_raw & (0x3F if bits == 64 else 0x1F)
+        if sub in (U.SH_RCL, U.SH_RCR):
+            count = count % (bits + 1)
+        if count == 0:
+            return
+        cf_in = int(self.get_flag(RFLAGS_CF))
+        of = self.get_flag(RFLAGS_OF)
+
+        if sub in (U.SH_SHL, U.SH_SAL):
+            r = (a << count) & mask
+            cf = bool((a >> (bits - count)) & 1) if count <= bits else False
+            of = (bool(r >> (bits - 1)) != cf) if count == 1 else of
+        elif sub == U.SH_SHR:
+            r = (a >> count) & mask
+            cf = bool((a >> (count - 1)) & 1) if count <= bits else False
+            of = bool(a >> (bits - 1)) if count == 1 else of
+        elif sub == U.SH_SAR:
+            sa = _sx(a, bits)
+            r = (sa >> count) & mask
+            cf = bool((sa >> (count - 1)) & 1)
+            of = False if count == 1 else of
+        elif sub == U.SH_ROL:
+            c = count % bits
+            r = ((a << c) | (a >> (bits - c))) & mask if c else a
+            cf = bool(r & 1)
+            of = (bool(r >> (bits - 1)) != cf) if count == 1 else of
+        elif sub == U.SH_ROR:
+            c = count % bits
+            r = ((a >> c) | (a << (bits - c))) & mask if c else a
+            cf = bool(r >> (bits - 1))
+            of = (bool(r >> (bits - 1)) != bool((r >> (bits - 2)) & 1)) \
+                if count == 1 else of
+        elif sub == U.SH_RCL:
+            wide = (cf_in << bits) | a
+            c = count
+            full = bits + 1
+            r_wide = ((wide << c) | (wide >> (full - c))) & ((1 << full) - 1)
+            r = r_wide & mask
+            cf = bool(r_wide >> bits)
+            of = (bool(r >> (bits - 1)) != cf) if count == 1 else of
+        else:  # RCR
+            wide = (cf_in << bits) | a
+            c = count
+            full = bits + 1
+            r_wide = ((wide >> c) | (wide << (full - c))) & ((1 << full) - 1)
+            r = r_wide & mask
+            cf = bool(r_wide >> bits)
+            of = (bool(a >> (bits - 1)) != cf_in) if count == 1 else of
+
+        if sub in (U.SH_RCL, U.SH_RCR):
+            self.set_flags(cf=cf, of=of)
+        else:
+            self.set_flags(cf=cf, of=of, zf=(r & mask) == 0,
+                           sf=bool((r & mask) >> (bits - 1)), pf=_parity(r))
+        store_dst(r)
+
+    def _exec_unary(self, uop, load_dst, store_dst, bits) -> None:
+        mask = (1 << bits) - 1
+        a = load_dst()
+        sub = uop.sub
+        if sub == U.UN_NOT:
+            store_dst(~a & mask)
+            return
+        cf = self.get_flag(RFLAGS_CF)
+        if sub == U.UN_INC:
+            r = (a + 1) & mask
+            self._flags_add(a, 1, r, bits)
+            self.set_flags(cf=cf)  # inc/dec preserve CF
+        elif sub == U.UN_DEC:
+            r = (a - 1) & mask
+            self._flags_sub(a, 1, r, bits)
+            self.set_flags(cf=cf)
+        else:  # NEG
+            r = (-a) & mask
+            self._flags_sub(0, a, r, bits)
+            self.set_flags(cf=a != 0)
+        store_dst(r)
+
+    def _exec_mul(self, uop, b, bits) -> None:
+        mask = (1 << bits) - 1
+        if uop.sub == U.MUL_2OP:
+            a = self.read_reg(uop.dst_reg, uop.opsize)
+            if uop.sext == 2:  # 3-operand: r = r/m * imm
+                a = b
+                b = uop.imm & mask
+            prod = _sx(a, bits) * _sx(b, bits)
+            r = prod & mask
+            overflow = prod != _sx(r, bits)
+            self.write_reg(uop.dst_reg, uop.opsize, r)
+            self.set_flags(cf=overflow, of=overflow, zf=False,
+                           sf=bool(r >> (bits - 1)), pf=_parity(r), af=False)
+            return
+        a = self.read_reg(0, uop.opsize)
+        if uop.sub == U.MUL_WIDE_U:
+            prod = a * b
+            overflow = prod >> bits != 0
+        else:
+            prod = _sx(a, bits) * _sx(b, bits)
+            overflow = prod != _sx(prod & mask, bits)
+            prod &= (1 << (bits * 2)) - 1
+        lo, hi = prod & mask, (prod >> bits) & mask
+        if uop.opsize == 1:
+            self.write_reg(0, 2, prod & 0xFFFF)  # ax = al*src
+        else:
+            self.write_reg(0, uop.opsize, lo)
+            self.write_reg(2, uop.opsize, hi)   # rdx
+        self.set_flags(cf=overflow, of=overflow)
+
+    def _exec_div(self, uop, b, bits) -> None:
+        mask = (1 << bits) - 1
+        if b == 0:
+            raise DivideError()
+        if uop.opsize == 1:
+            dividend = self.read_reg(0, 2)  # ax
+        else:
+            dividend = (self.read_reg(2, uop.opsize) << bits) | \
+                self.read_reg(0, uop.opsize)
+        if uop.sub == U.DIV_U:
+            q, r = divmod(dividend, b)
+            if q > mask:
+                raise DivideError()
+        else:
+            sd = _sx(dividend, bits * 2)
+            sb = _sx(b, bits)
+            q = int(sd / sb)  # truncation toward zero
+            r = sd - q * sb
+            if q > (mask >> 1) or q < -(mask >> 1) - 1:
+                raise DivideError()
+        if uop.opsize == 1:
+            self.write_reg(0, 1, q & 0xFF)
+            self.write_reg(U.REG_AH_BASE, 1, r & 0xFF)  # ah
+        else:
+            self.write_reg(0, uop.opsize, q & mask)
+            self.write_reg(2, uop.opsize, r & mask)
+
+    def _exec_string(self, uop, opsize) -> bool:
+        """One string-op iteration; returns True when rip should advance."""
+        if uop.rep != U.REP_NONE and self.gpr[1] == 0:  # rcx
+            return True
+        delta = -opsize if self.get_flag(RFLAGS_DF) else opsize
+        sub = uop.sub
+        rsi, rdi = self.gpr[6], self.gpr[7]
+        if sub == U.STR_MOVS:
+            self.virt_write(rdi, self.virt_read(rsi, opsize))
+            self.gpr[6] = (rsi + delta) & MASK64
+            self.gpr[7] = (rdi + delta) & MASK64
+        elif sub == U.STR_STOS:
+            self.write_u(rdi, opsize, self.read_reg(0, opsize))
+            self.gpr[7] = (rdi + delta) & MASK64
+        elif sub == U.STR_LODS:
+            self.write_reg(0, opsize, self.read_u(rsi, opsize))
+            self.gpr[6] = (rsi + delta) & MASK64
+        elif sub == U.STR_SCAS:
+            a = self.read_reg(0, opsize)
+            b = self.read_u(rdi, opsize)
+            self._flags_sub(a, b, (a - b) & ((1 << (opsize * 8)) - 1), opsize * 8)
+            self.gpr[7] = (rdi + delta) & MASK64
+        elif sub == U.STR_CMPS:
+            a = self.read_u(rsi, opsize)
+            b = self.read_u(rdi, opsize)
+            self._flags_sub(a, b, (a - b) & ((1 << (opsize * 8)) - 1), opsize * 8)
+            self.gpr[6] = (rsi + delta) & MASK64
+            self.gpr[7] = (rdi + delta) & MASK64
+
+        if uop.rep == U.REP_NONE:
+            return True
+        self.gpr[1] = (self.gpr[1] - 1) & MASK64
+        if self.gpr[1] == 0:
+            return True
+        if sub in (U.STR_SCAS, U.STR_CMPS):
+            zf = self.get_flag(RFLAGS_ZF)
+            if uop.rep == U.REP_REP and not zf:
+                return True
+            if uop.rep == U.REP_REPNE and zf:
+                return True
+        return False
+
+    def _exec_convert(self, uop, bits) -> None:
+        if uop.sub == 0:  # cbw/cwde/cdqe: widen half-size rax into rax
+            half = bits // 2
+            val = _sx(self.read_reg(0, uop.opsize) & ((1 << half) - 1), half)
+            self.write_reg(0, uop.opsize, val & ((1 << bits) - 1))
+        else:  # cwd/cdq/cqo: rdx = sign of rax
+            sign = (self.read_reg(0, uop.opsize) >> (bits - 1)) & 1
+            self.write_reg(2, uop.opsize, ((1 << bits) - 1) if sign else 0)
+
+    def _exec_bt(self, uop, ea, bits) -> None:
+        if uop.src_kind == U.K_IMM:
+            offset = uop.imm & (bits - 1)
+            bit_base_adjust = 0
+        else:
+            # register bit index addresses a bit *string* for memory forms:
+            # EA moves by opsize for every `bits` of signed offset
+            raw = self.read_reg(uop.src_reg, uop.opsize)
+            signed = _sx(raw, bits)
+            offset = signed & (bits - 1)
+            bit_base_adjust = (signed - offset) // bits * uop.opsize
+        if uop.dst_kind == U.K_MEM:
+            addr = (ea + bit_base_adjust) & MASK64
+            val = self.read_u(addr, uop.opsize)
+        else:
+            val = self.read_reg(uop.dst_reg, uop.opsize)
+        bit = (val >> offset) & 1
+        self.set_flags(cf=bool(bit))
+        sub = uop.sub
+        if sub == U.BT_BT:
+            return
+        if sub == U.BT_BTS:
+            val |= 1 << offset
+        elif sub == U.BT_BTR:
+            val &= ~(1 << offset)
+        else:
+            val ^= 1 << offset
+        if uop.dst_kind == U.K_MEM:
+            self.write_u(addr, uop.opsize, val)
+        else:
+            self.write_reg(uop.dst_reg, uop.opsize, val)
+
+    def _exec_bitscan(self, uop, src, bits) -> None:
+        sub = uop.sub
+        if sub == U.BS_POPCNT:
+            r = bin(src).count("1")
+            self.write_reg(uop.dst_reg, uop.opsize, r)
+            self.set_flags(cf=False, of=False, af=False, sf=False,
+                           pf=False, zf=src == 0)
+            return
+        if sub in (U.BS_TZCNT, U.BS_LZCNT):
+            if src == 0:
+                r = bits
+            elif sub == U.BS_TZCNT:
+                r = (src & -src).bit_length() - 1
+            else:
+                r = bits - src.bit_length()
+            self.write_reg(uop.dst_reg, uop.opsize, r)
+            self.set_flags(cf=src == 0, zf=r == 0)
+            return
+        if src == 0:
+            self.set_flags(zf=True)
+            return  # dest unmodified (Intel "undefined", hardware keeps it)
+        if sub == U.BS_BSF:
+            r = (src & -src).bit_length() - 1
+        else:
+            r = src.bit_length() - 1
+        self.write_reg(uop.dst_reg, uop.opsize, r)
+        self.set_flags(zf=False)
+
+    def _exec_flagop(self, uop) -> None:
+        sub = uop.sub
+        if sub == U.FL_CLC:
+            self.set_flags(cf=False)
+        elif sub == U.FL_STC:
+            self.set_flags(cf=True)
+        elif sub == U.FL_CMC:
+            self.set_flags(cf=not self.get_flag(RFLAGS_CF))
+        elif sub == U.FL_CLD:
+            self.set_flags(df=False)
+        elif sub == U.FL_STD:
+            self.set_flags(df=True)
+        elif sub == U.FL_CLI:
+            self.rflags &= ~0x200
+        elif sub == U.FL_STI:
+            self.rflags |= 0x200
+        elif sub == U.FL_SAHF:
+            ah = self.read_reg(U.REG_AH_BASE, 1)
+            self.rflags = (self.rflags & ~0xD5) | (ah & 0xD5) | 0x2
+        else:  # LAHF
+            self.write_reg(U.REG_AH_BASE, 1, (self.rflags & 0xD7) | 0x2)
+
+    def _exec_movcr(self, uop) -> None:
+        cr = uop.sub
+        if uop.sext == 0:  # read
+            val = {0: self.cr0, 2: 0, 3: self.cr3, 4: self.cr4, 8: self.cr8} \
+                .get(cr)
+            if val is None:
+                raise UnsupportedInsn(self.rip, uop.raw)
+            self.write_reg(uop.dst_reg, 8, val)
+        else:
+            val = self.read_reg(uop.src_reg, 8)
+            if cr == 3:
+                # recorded, not raised: rip still advances; the backend turns
+                # a differing cr3 into Cr3Change after the step (reference
+                # tlb_cntrl hook bochscpu_backend.cc:628-657)
+                self.cr3 = val
+                self.cr3_event = val
+            elif cr == 0:
+                self.cr0 = val
+            elif cr == 4:
+                self.cr4 = val
+            elif cr == 8:
+                self.cr8 = val
+            else:
+                raise UnsupportedInsn(self.rip, uop.raw)
+
+    # -- SSE -------------------------------------------------------------
+    def _read_xmm_bytes(self, idx: int, size: int) -> bytes:
+        lo, hi = self.xmm[idx]
+        return (lo | (hi << 64)).to_bytes(16, "little")[:size]
+
+    def _write_xmm_bytes(self, idx: int, data: bytes, merge: bool) -> None:
+        if merge:
+            cur = bytearray(self._read_xmm_bytes(idx, 16))
+            cur[: len(data)] = data
+            data = bytes(cur)
+        else:
+            data = data.ljust(16, b"\x00")
+        val = int.from_bytes(data, "little")
+        self.xmm[idx] = [val & MASK64, val >> 64]
+
+    def _exec_ssemov(self, uop, ea) -> None:
+        size = uop.opsize
+        if uop.sub == 1:  # gpr -> xmm (zero upper)
+            val = self.read_reg(uop.src_reg, size)
+            self._write_xmm_bytes(uop.dst_reg, val.to_bytes(size, "little"),
+                                  merge=False)
+            return
+        if uop.sub == 2:  # xmm -> gpr/mem
+            data = self._read_xmm_bytes(uop.src_reg, size)
+            if uop.dst_kind == U.K_MEM:
+                self.virt_write(ea, data)
+            else:
+                self.write_reg(uop.dst_reg, size,
+                               int.from_bytes(data, "little"))
+            return
+        # plain moves
+        if uop.src_kind == U.K_XMM:
+            data = self._read_xmm_bytes(uop.src_reg, size)
+        elif uop.src_kind == U.K_MEM:
+            data = self.virt_read(ea, size)
+        else:
+            raise UnsupportedInsn(self.rip, uop.raw)
+        if uop.dst_kind == U.K_XMM:
+            # movss/movsd xmm,xmm merge low lanes; movq (sub=3) and loads
+            # from memory zero the upper lane
+            merge = uop.src_kind == U.K_XMM and size < 16 and uop.sub != 3
+            self._write_xmm_bytes(uop.dst_reg, data, merge=merge)
+        elif uop.dst_kind == U.K_MEM:
+            self.virt_write(ea, data)
+        else:
+            raise UnsupportedInsn(self.rip, uop.raw)
+
+    def _exec_ssealu(self, uop, ea) -> None:
+        sub = uop.sub
+        if uop.src_kind == U.K_XMM:
+            src = self._read_xmm_bytes(uop.src_reg, 16)
+        elif uop.src_kind == U.K_MEM:
+            src = self.virt_read(ea, 16)
+        elif uop.src_kind == U.K_IMM:
+            src = b""
+        else:
+            src = b"\x00" * 16
+
+        if sub == U.SSE_PMOVMSKB:
+            data = self._read_xmm_bytes(uop.src_reg, 16)
+            maskbits = 0
+            for i, byte in enumerate(data):
+                maskbits |= ((byte >> 7) & 1) << i
+            self.write_reg(uop.dst_reg, 4, maskbits)
+            return
+
+        dst = self._read_xmm_bytes(uop.dst_reg, 16)
+        if sub == U.SSE_PTEST:
+            d = int.from_bytes(dst, "little")
+            s = int.from_bytes(src, "little")
+            self.set_flags(zf=(d & s) == 0, cf=(~d & s) & ((1 << 128) - 1) == 0,
+                           of=False, af=False, sf=False, pf=False)
+            return
+        if sub in (U.SSE_PXOR, U.SSE_XORPS):
+            out = bytes(a ^ b for a, b in zip(dst, src))
+        elif sub == U.SSE_POR:
+            out = bytes(a | b for a, b in zip(dst, src))
+        elif sub == U.SSE_PAND:
+            out = bytes(a & b for a, b in zip(dst, src))
+        elif sub == U.SSE_PANDN:
+            out = bytes(~a & b & 0xFF for a, b in zip(dst, src))
+        elif sub == U.SSE_PCMPEQB:
+            out = bytes(0xFF if a == b else 0 for a, b in zip(dst, src))
+        elif sub == U.SSE_PCMPEQW:
+            out = b"".join(
+                (b"\xff\xff" if dst[i : i + 2] == src[i : i + 2] else b"\x00\x00")
+                for i in range(0, 16, 2))
+        elif sub == U.SSE_PCMPEQD:
+            out = b"".join(
+                (b"\xff" * 4 if dst[i : i + 4] == src[i : i + 4] else b"\x00" * 4)
+                for i in range(0, 16, 4))
+        elif sub == U.SSE_PSUBB:
+            out = bytes((a - b) & 0xFF for a, b in zip(dst, src))
+        elif sub == U.SSE_PADDB:
+            out = bytes((a + b) & 0xFF for a, b in zip(dst, src))
+        elif sub == U.SSE_PMINUB:
+            out = bytes(min(a, b) for a, b in zip(dst, src))
+        elif sub == U.SSE_PUNPCKLQDQ:
+            out = dst[:8] + src[:8]
+        elif sub == U.SSE_PSHUFD:
+            sel = uop.imm
+            out = b"".join(
+                src[((sel >> (2 * i)) & 3) * 4 : ((sel >> (2 * i)) & 3) * 4 + 4]
+                for i in range(4))
+        elif sub == U.SSE_PSLLDQ:
+            n = min(uop.imm, 16)
+            out = (b"\x00" * n + dst)[:16]
+        elif sub == U.SSE_PSRLDQ:
+            n = min(uop.imm, 16)
+            out = (dst[n:] + b"\x00" * 16)[:16]
+        else:
+            raise UnsupportedInsn(self.rip, uop.raw)
+        self._write_xmm_bytes(uop.dst_reg, out, merge=False)
+
+
+class GuestCrash(Exception):
+    """int3/int n/ud2/hlt executed — surfaced as a Crash result (matching the
+    reference's interrupt/hlt handling, bochscpu_backend.cc:595-619,690-697)."""
+
+    def __init__(self, rip: int, uop: U.Uop):
+        super().__init__(f"guest fault at {rip:#x} (opc={uop.opc} sub={uop.sub})")
+        self.rip = rip
+        self.uop = uop
+
+
