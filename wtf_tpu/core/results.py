@@ -27,6 +27,20 @@ class StatusCode(enum.IntEnum):
     BREAKPOINT = 5  # paused at a breakpoint awaiting host servicing
     UNSUPPORTED = 6 # interpreter hit an unimplemented instruction
     PAGE_FAULT = 7  # unresolvable translation (pending host/guest servicing)
+    NEED_DECODE = 8   # rip not in the uop table; host must decode + resume
+    SMC = 9           # lane's code bytes diverge from the shared decode cache
+    OVERLAY_FULL = 10 # lane ran out of dirty-page overlay slots
+    DIVIDE_ERROR = 11 # #DE (div by zero / quotient overflow)
+
+
+# Statuses the device can set that the host run loop must service before the
+# lane can make further progress (vs. terminal testcase outcomes).
+SERVICEABLE = (
+    StatusCode.NEED_DECODE,
+    StatusCode.BREAKPOINT,
+    StatusCode.SMC,
+    StatusCode.UNSUPPORTED,
+)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,15 @@
+"""The vmapped x86-64 interpreter: the TPU-native replacement for bochscpu.
+
+Where the reference runs one guest at a time inside an instrumented emulator
+(reference src/wtf/bochscpu_backend.cc), this package runs a *batch* of
+guests in lockstep on the device:
+
+  uoptable.py - host-managed decode cache resident on device (bytes are
+                decoded once per unique RIP, like a JIT's translation cache)
+  machine.py  - per-lane architectural state as SoA arrays [lanes, ...]
+  step.py     - the single-instruction transition function, vmapped over
+                lanes, with lane masking for divergence and per-lane
+                status codes for anything needing host servicing
+  runner.py   - host orchestration: chunked device runs, decode servicing,
+                breakpoint dispatch, oracle fallback for rare instructions
+"""

@@ -1,0 +1,152 @@
+"""Batched machine state: every lane is one guest, stored SoA on device.
+
+Equivalent of the reference's per-VM register/memory state (`CpuState_t`
+loaded into bochs/KVM/WHV at `Initialize`/`Restore`, reference
+src/wtf/bochscpu_backend.cc:1026-1122), redesigned for lockstep batch
+execution: all architectural state lives in `[lanes, ...]` arrays so one
+vmapped transition function advances every guest at once, and `Restore()` is
+a functional rebuild from the snapshot broadcast — no per-page rollback loop.
+
+Only the state the interpreter subset actually reads/writes is device
+resident (GPRs, rip, rflags, XMM0-15, segment bases, control registers,
+syscall MSRs).  The full `CpuState` (x87 stack, debug registers, the other
+16 ZMM...) stays host-side in the snapshot and is restored by construction
+since the device never mutates it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wtf_tpu.core.cpustate import CpuState
+from wtf_tpu.core.results import StatusCode
+from wtf_tpu.mem.overlay import DirtyOverlay, overlay_init
+
+
+class Machine(NamedTuple):
+    """All fields carry a leading lane axis."""
+
+    # Architectural state
+    gpr: jax.Array        # uint64[L, 16] (x86 encoding order)
+    rip: jax.Array        # uint64[L]
+    rflags: jax.Array     # uint64[L]
+    xmm: jax.Array        # uint64[L, 16, 2] (lo, hi limbs)
+    fs_base: jax.Array    # uint64[L]
+    gs_base: jax.Array    # uint64[L]
+    kernel_gs_base: jax.Array  # uint64[L]
+    cr0: jax.Array        # uint64[L]
+    cr3: jax.Array        # uint64[L]
+    cr4: jax.Array        # uint64[L]
+    cr8: jax.Array        # uint64[L]
+    lstar: jax.Array      # uint64[L]
+    star: jax.Array       # uint64[L]
+    sfmask: jax.Array     # uint64[L]
+    tsc: jax.Array        # uint64[L]
+
+    # Run bookkeeping
+    status: jax.Array     # int32[L] (core.results.StatusCode)
+    icount: jax.Array     # uint64[L] executed instructions this testcase
+    rdrand: jax.Array     # uint64[L] deterministic rdrand chain state
+    cr3_base: jax.Array   # uint64[L] snapshot cr3 (writes != this stop the lane)
+    bp_skip: jax.Array    # int32[L] suppress bp check for one step post-resume
+    fault_gva: jax.Array  # uint64[L] faulting address (PAGE_FAULT/SMC detail)
+    fault_write: jax.Array  # int32[L] 1 when the faulting access was a write
+
+    # Coverage (reference: robin_set<Gva_t> per run + edge hash inserts,
+    # bochscpu_backend.cc:479-548,699-728 — here: per-lane bitmaps)
+    cov: jax.Array        # uint32[L, cap/32] bit per uop-table entry executed
+    edge: jax.Array       # uint32[L, EW] splitmix64 edge-hash bitmap
+
+    # Guest memory writes (copy-on-write; reset = Restore)
+    overlay: DirtyOverlay  # fields carry the lane axis
+
+    @property
+    def n_lanes(self) -> int:
+        return self.rip.shape[0]
+
+
+def cpu_vector(cpu: CpuState) -> np.ndarray:
+    """Flatten the device-resident scalar registers of a CpuState in the
+    order machine_init broadcasts them (host-side helper for lane reload)."""
+    return np.array(
+        cpu.gpr_list()
+        + [
+            cpu.rip, cpu.rflags | 0x2, cpu.fs.base, cpu.gs.base,
+            cpu.kernel_gs_base, cpu.cr0, cpu.cr3, cpu.cr4, cpu.cr8,
+            cpu.lstar, cpu.star, cpu.sfmask, cpu.tsc,
+        ],
+        dtype=np.uint64,
+    )
+
+
+def machine_init(
+    cpu: CpuState,
+    n_lanes: int,
+    uop_capacity: int,
+    overlay_slots: int = 128,
+    edge_bits: int = 17,
+) -> Machine:
+    """Build the batch with every lane at the snapshot state."""
+    ones = np.ones(n_lanes, dtype=np.uint64)
+
+    def bcast(value: int) -> jax.Array:
+        return jnp.asarray(ones * np.uint64(value & (1 << 64) - 1))
+
+    gpr = np.tile(np.array(cpu.gpr_list(), dtype=np.uint64), (n_lanes, 1))
+    xmm = np.zeros((n_lanes, 16, 2), dtype=np.uint64)
+    for i in range(16):
+        xmm[:, i, 0] = np.uint64(cpu.zmm[i][0])
+        xmm[:, i, 1] = np.uint64(cpu.zmm[i][1])
+
+    return Machine(
+        gpr=jnp.asarray(gpr),
+        rip=bcast(cpu.rip),
+        rflags=bcast(cpu.rflags | 0x2),
+        xmm=jnp.asarray(xmm),
+        fs_base=bcast(cpu.fs.base),
+        gs_base=bcast(cpu.gs.base),
+        kernel_gs_base=bcast(cpu.kernel_gs_base),
+        cr0=bcast(cpu.cr0),
+        cr3=bcast(cpu.cr3),
+        cr4=bcast(cpu.cr4),
+        cr8=bcast(cpu.cr8),
+        lstar=bcast(cpu.lstar),
+        star=bcast(cpu.star),
+        sfmask=bcast(cpu.sfmask),
+        tsc=bcast(cpu.tsc),
+        status=jnp.full((n_lanes,), int(StatusCode.RUNNING), dtype=jnp.int32),
+        icount=jnp.zeros((n_lanes,), dtype=jnp.uint64),
+        rdrand=jnp.zeros((n_lanes,), dtype=jnp.uint64),
+        cr3_base=bcast(cpu.cr3),
+        bp_skip=jnp.zeros((n_lanes,), dtype=jnp.int32),
+        fault_gva=jnp.zeros((n_lanes,), dtype=jnp.uint64),
+        fault_write=jnp.zeros((n_lanes,), dtype=jnp.int32),
+        cov=jnp.zeros((n_lanes, uop_capacity // 32), dtype=jnp.uint32),
+        edge=jnp.zeros((n_lanes, (1 << edge_bits) // 32), dtype=jnp.uint32),
+        overlay=overlay_init(n_lanes, overlay_slots),
+    )
+
+
+def machine_restore(machine: Machine, snapshot_template: Machine) -> Machine:
+    """Restore(): every lane back to the snapshot.  O(1) in guest memory —
+    replaces the reference's dirty-page rewrite loops (SURVEY.md §5.4).
+
+    `snapshot_template` is the pristine machine from machine_init (its big
+    arrays — overlay data, coverage — are reused functionally; XLA aliases
+    the zero-fill)."""
+    return snapshot_template._replace(
+        # Keep the overlay *storage* from the live machine so no new buffers
+        # are allocated; reset just the indexing state.
+        overlay=DirtyOverlay(
+            pfn=jnp.full_like(machine.overlay.pfn, -1),
+            data=machine.overlay.data,
+            count=jnp.zeros_like(machine.overlay.count),
+            overflow=jnp.zeros_like(machine.overlay.overflow),
+        ),
+        cov=jnp.zeros_like(machine.cov),
+        edge=jnp.zeros_like(machine.edge),
+    )

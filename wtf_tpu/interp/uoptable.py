@@ -1,0 +1,211 @@
+"""Device-resident decode cache: the batch interpreter's translation table.
+
+The reference decodes instruction bytes inside the emulator on every
+execution (bochscpu's fetch-decode-execute loop).  On TPU that per-byte,
+branchy work would serialize the VPU, so the host decodes each unique RIP
+exactly once (wtf_tpu/cpu/decoder.py) and publishes the result here as
+fixed-width parallel arrays the device indexes with a hash probe — the same
+role a JIT translation cache plays.
+
+Contents per entry (capacity rows):
+  rip       u64  - guest virtual address of the instruction
+  fields    i32  - the Uop's integer fields (uops.INT_FIELDS order)
+  disp/imm  u64  - displacement / immediate
+  raw_lo/hi u64  - first 16 raw bytes (SMC verification; length-masked)
+  pfn0/pfn1 i32  - decode-time code page frames (dirty-code check)
+  bp        i32  - 1 when a breakpoint is armed at this rip (the batched
+                   equivalent of the reference's 0xcc patching +
+                   `SetBreakpoint`, reference src/wtf/backend.h:231)
+
+Lookup is open-addressed linear probing over `hash_tab` (slot -> entry index
+or -1), probe sequence splitmix64(rip) + k for k < PROBES.  The host inserter
+enforces the same probe bound, so a device miss <=> rip genuinely undecoded,
+surfacing as per-lane NEED_DECODE status for the runner to service.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wtf_tpu.cpu.uops import INT_FIELDS, Uop
+from wtf_tpu.utils.hashing import splitmix64
+
+NF = len(INT_FIELDS)
+# Shared host/device probe bound.  The host re-hashes everything into a
+# bigger table if an insert would exceed it, so device lookups stay O(PROBES).
+PROBES = 8
+
+_FIELD_INDEX = {name: i for i, name in enumerate(INT_FIELDS)}
+F_OPC = _FIELD_INDEX["opc"]
+F_SUB = _FIELD_INDEX["sub"]
+F_COND = _FIELD_INDEX["cond"]
+F_LENGTH = _FIELD_INDEX["length"]
+F_OPSIZE = _FIELD_INDEX["opsize"]
+F_SRCSIZE = _FIELD_INDEX["srcsize"]
+F_SEXT = _FIELD_INDEX["sext"]
+F_DST_KIND = _FIELD_INDEX["dst_kind"]
+F_DST_REG = _FIELD_INDEX["dst_reg"]
+F_SRC_KIND = _FIELD_INDEX["src_kind"]
+F_SRC_REG = _FIELD_INDEX["src_reg"]
+F_BASE_REG = _FIELD_INDEX["base_reg"]
+F_IDX_REG = _FIELD_INDEX["idx_reg"]
+F_SCALE = _FIELD_INDEX["scale"]
+F_SEG = _FIELD_INDEX["seg"]
+F_REP = _FIELD_INDEX["rep"]
+F_LOCK = _FIELD_INDEX["lock"]
+
+
+class UopTable(NamedTuple):
+    """Device arrays; broadcast (unmapped) under vmap over lanes."""
+
+    rip: jax.Array       # uint64[capacity]
+    fields: jax.Array    # int32[capacity, NF]
+    disp: jax.Array      # uint64[capacity]
+    imm: jax.Array       # uint64[capacity]
+    raw_lo: jax.Array    # uint64[capacity]
+    raw_hi: jax.Array    # uint64[capacity]
+    pfn0: jax.Array      # int32[capacity]
+    pfn1: jax.Array      # int32[capacity]
+    bp: jax.Array        # int32[capacity]
+    hash_tab: jax.Array  # int32[hash_size]; entry index or -1
+
+
+def _pack_raw(raw: bytes) -> Tuple[int, int]:
+    padded = raw[:16].ljust(16, b"\x00")
+    lo = int.from_bytes(padded[:8], "little")
+    hi = int.from_bytes(padded[8:16], "little")
+    return lo, hi
+
+
+class DecodeCache:
+    """Host mirror of the device table; owns insertion and breakpoint state."""
+
+    def __init__(self, capacity: int = 1 << 15, hash_factor: int = 4):
+        self.capacity = capacity
+        self.hash_size = 1
+        while self.hash_size < capacity * hash_factor:
+            self.hash_size *= 2
+        self.count = 0
+        self.rip = np.zeros(capacity, dtype=np.uint64)
+        self.fields = np.zeros((capacity, NF), dtype=np.int32)
+        self.disp = np.zeros(capacity, dtype=np.uint64)
+        self.imm = np.zeros(capacity, dtype=np.uint64)
+        self.raw_lo = np.zeros(capacity, dtype=np.uint64)
+        self.raw_hi = np.zeros(capacity, dtype=np.uint64)
+        self.pfn0 = np.zeros(capacity, dtype=np.int32)
+        self.pfn1 = np.zeros(capacity, dtype=np.int32)
+        self.bp = np.zeros(capacity, dtype=np.int32)
+        self.hash_tab = np.full(self.hash_size, -1, dtype=np.int32)
+        self.index: Dict[int, int] = {}      # rip -> entry idx
+        self.uops: Dict[int, Uop] = {}       # rip -> host Uop (debug/oracle)
+        # Breakpoints may be registered before their rip is ever decoded
+        # (symbol breakpoints at Init time, reference backend.cc:214-239).
+        self.pending_bps: Set[int] = set()
+        self._device: Optional[UopTable] = None
+
+    # -- insertion -------------------------------------------------------
+    def _hash_insert(self, rip: int, idx: int) -> bool:
+        h = splitmix64(rip)
+        mask = self.hash_size - 1
+        for k in range(PROBES):
+            slot = (h + k) & mask
+            if self.hash_tab[slot] < 0:
+                self.hash_tab[slot] = idx
+                return True
+        return False
+
+    def _rehash(self) -> None:
+        self.hash_size *= 2
+        while True:
+            self.hash_tab = np.full(self.hash_size, -1, dtype=np.int32)
+            ok = all(
+                self._hash_insert(int(self.rip[i]), i) for i in range(self.count)
+            )
+            if ok:
+                return
+            self.hash_size *= 2
+
+    def add(self, rip: int, uop: Uop, pfn0: int, pfn1: int) -> int:
+        """Insert a decoded instruction; returns its entry index."""
+        existing = self.index.get(rip)
+        if existing is not None:
+            return existing
+        if self.count >= self.capacity:
+            raise RuntimeError(
+                f"uop table full ({self.capacity}); raise capacity"
+            )
+        idx = self.count
+        self.count += 1
+        self.rip[idx] = np.uint64(rip)
+        for f, name in enumerate(INT_FIELDS):
+            self.fields[idx, f] = getattr(uop, name)
+        self.disp[idx] = np.uint64(uop.disp & ((1 << 64) - 1))
+        self.imm[idx] = np.uint64(uop.imm & ((1 << 64) - 1))
+        lo, hi = _pack_raw(uop.raw)
+        self.raw_lo[idx] = np.uint64(lo)
+        self.raw_hi[idx] = np.uint64(hi)
+        self.pfn0[idx] = pfn0
+        self.pfn1[idx] = pfn1
+        self.bp[idx] = 1 if rip in self.pending_bps else 0
+        if not self._hash_insert(rip, idx):
+            self._rehash()
+        self.index[rip] = idx
+        self.uops[rip] = uop
+        self._device = None
+        return idx
+
+    # -- breakpoints -----------------------------------------------------
+    def set_breakpoint(self, gva: int) -> None:
+        self.pending_bps.add(gva)
+        idx = self.index.get(gva)
+        if idx is not None and self.bp[idx] != 1:
+            self.bp[idx] = 1
+            self._device = None
+
+    def clear_breakpoint(self, gva: int) -> None:
+        self.pending_bps.discard(gva)
+        idx = self.index.get(gva)
+        if idx is not None and self.bp[idx] != 0:
+            self.bp[idx] = 0
+            self._device = None
+
+    def has_breakpoint(self, gva: int) -> bool:
+        return gva in self.pending_bps
+
+    # -- device view -----------------------------------------------------
+    def device(self) -> UopTable:
+        """Upload (or return cached) device arrays."""
+        if self._device is None:
+            self._device = UopTable(
+                rip=jnp.asarray(self.rip),
+                fields=jnp.asarray(self.fields),
+                disp=jnp.asarray(self.disp),
+                imm=jnp.asarray(self.imm),
+                raw_lo=jnp.asarray(self.raw_lo),
+                raw_hi=jnp.asarray(self.raw_hi),
+                pfn0=jnp.asarray(self.pfn0),
+                pfn1=jnp.asarray(self.pfn1),
+                bp=jnp.asarray(self.bp),
+                hash_tab=jnp.asarray(self.hash_tab),
+            )
+        return self._device
+
+    def rip_of(self, idx: int) -> int:
+        return int(self.rip[idx])
+
+    def rips_of_bits(self, words: np.ndarray) -> list:
+        """Decode a coverage bitmap (u32 words over entry indices) to RIPs."""
+        out = []
+        bits = np.nonzero(words)[0]
+        for word_idx in bits:
+            word = int(words[word_idx])
+            base = word_idx * 32
+            while word:
+                low = word & -word
+                out.append(int(self.rip[base + low.bit_length() - 1]))
+                word ^= low
+        return out
