@@ -2,7 +2,13 @@
 
 Multi-chip hardware isn't available in CI; sharded paths are validated on a
 virtual CPU mesh (jax's xla_force_host_platform_device_count), matching the
-driver's dryrun_multichip environment.  Must run before jax is imported.
+driver's dryrun_multichip environment.
+
+Robustness note: some environments pre-register a TPU PJRT plugin from a
+sitecustomize hook and export JAX_PLATFORMS=<plugin> — in that case jax is
+already imported before this conftest runs and mutating os.environ alone is
+too late.  jax.config.update("jax_platforms", ...) still wins as long as no
+backend has been initialized, so we set both.
 """
 
 import os
@@ -13,3 +19,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
