@@ -125,7 +125,7 @@ def machine_init(
         bp_skip=jnp.zeros((n_lanes,), dtype=jnp.int32),
         fault_gva=jnp.zeros((n_lanes,), dtype=jnp.uint64),
         fault_write=jnp.zeros((n_lanes,), dtype=jnp.int32),
-        cov=jnp.zeros((n_lanes, uop_capacity // 32), dtype=jnp.uint32),
+        cov=jnp.zeros((n_lanes, (uop_capacity + 31) // 32), dtype=jnp.uint32),
         edge=jnp.zeros((n_lanes, (1 << edge_bits) // 32), dtype=jnp.uint32),
         overlay=overlay_init(n_lanes, overlay_slots),
     )
@@ -135,9 +135,11 @@ def machine_restore(machine: Machine, snapshot_template: Machine) -> Machine:
     """Restore(): every lane back to the snapshot.  O(1) in guest memory —
     replaces the reference's dirty-page rewrite loops (SURVEY.md §5.4).
 
-    `snapshot_template` is the pristine machine from machine_init (its big
-    arrays — overlay data, coverage — are reused functionally; XLA aliases
-    the zero-fill)."""
+    `snapshot_template` is the pristine machine from machine_init.  Only its
+    small per-lane register/bookkeeping arrays are used; the overlay STORAGE
+    always comes from the live machine and cov/edge are rebuilt as zeros, so
+    build the template with `overlay_slots=0` to avoid holding a second
+    multi-GiB overlay buffer alive."""
     return snapshot_template._replace(
         # Keep the overlay *storage* from the live machine so no new buffers
         # are allocated; reset just the indexing state.

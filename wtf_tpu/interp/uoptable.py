@@ -158,6 +158,27 @@ class DecodeCache:
         self._device = None
         return idx
 
+    def update(self, rip: int, uop: Uop, pfn0: int, pfn1: int) -> int:
+        """Re-publish a rip whose bytes changed (self-modifying code / SMC
+        servicing).  Overwrites the existing entry in place — the entry index
+        is stable, so coverage-bitmap indices stay valid — or inserts when
+        the rip was never decoded."""
+        idx = self.index.get(rip)
+        if idx is None:
+            return self.add(rip, uop, pfn0, pfn1)
+        for f, name in enumerate(INT_FIELDS):
+            self.fields[idx, f] = getattr(uop, name)
+        self.disp[idx] = np.uint64(uop.disp & ((1 << 64) - 1))
+        self.imm[idx] = np.uint64(uop.imm & ((1 << 64) - 1))
+        lo, hi = _pack_raw(uop.raw)
+        self.raw_lo[idx] = np.uint64(lo)
+        self.raw_hi[idx] = np.uint64(hi)
+        self.pfn0[idx] = pfn0
+        self.pfn1[idx] = pfn1
+        self.uops[rip] = uop
+        self._device = None
+        return idx
+
     # -- breakpoints -----------------------------------------------------
     def set_breakpoint(self, gva: int) -> None:
         self.pending_bps.add(gva)

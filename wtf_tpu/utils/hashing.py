@@ -21,16 +21,24 @@ def hex_digest(data: bytes) -> str:
 
 
 def splitmix64(x: int) -> int:
-    """splitmix64 finalizer; must match the device-side version in
-    wtf_tpu/interp/step.py exactly (same constants as the reference's edge
-    hash, bochscpu_backend.cc:699-728)."""
+    """Full splitmix64 step (increment + finalizer).  Used for internal hash
+    tables (decode-cache probing); NOT the edge hash — the reference's edge
+    mix skips the additive increment (see mix64)."""
     x = (x + 0x9E3779B97F4A7C15) & MASK64
-    z = x
+    return mix64(x)
+
+
+def mix64(z: int) -> int:
+    """splitmix64's mixing steps only (no increment) — bit-for-bit the chain
+    the reference's RecordEdge applies to RIP
+    (src/wtf/bochscpu_backend.cc:699-728).  Must match the device-side
+    version in wtf_tpu/interp/step.py exactly."""
     z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & MASK64
     z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & MASK64
     return (z ^ (z >> 31)) & MASK64
 
 
 def edge_hash(rip: int, next_rip: int) -> int:
-    """Edge identity: splitmix64(rip) xor next_rip (bochscpu_backend.cc:720-724)."""
-    return (splitmix64(rip) ^ next_rip) & MASK64
+    """Edge identity: mix64(rip) xor next_rip — bit-for-bit the reference's
+    RecordEdge (bochscpu_backend.cc:699-724)."""
+    return (mix64(rip) ^ next_rip) & MASK64
