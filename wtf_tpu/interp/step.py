@@ -1,0 +1,1235 @@
+"""Device-side x86-64 transition function: one instruction, one lane, vmapped.
+
+This is the TPU-native replacement for the reference's emulator hot loop
+(bochscpu's fetch-decode-execute + hook chain, reference
+src/wtf/bochscpu_backend.cc:352-548): instead of one guest stepping through
+branchy C++ per instruction, every lane of the batch advances one
+*pre-decoded* uop per call, fully vectorized, with lane masking for
+divergence.  The host decodes (cpu/decoder.py), publishes uops to the device
+table (interp/uoptable.py), and this module consumes them.
+
+Structure of `step_lane` (single lane; `jax.vmap` adds the lane axis):
+  1. hash-probe the uop table with rip          -> NEED_DECODE on miss
+  2. breakpoint check (honoring bp_skip)        -> BREAKPOINT (pre-execution,
+     like the reference's BeforeExecutionHook dispatch, bochscpu:545-547)
+  3. self-modifying-code check: current code bytes (through the lane's dirty
+     overlay) vs the decode-time raw bytes      -> SMC
+  4. effective address, at most two generic loads (src-like / dst-like),
+     ALU/flag select over op classes mirroring cpu/emu.py semantics exactly,
+     one store, register writebacks
+  5. rip / rflags / status / icount update; coverage bit (per uop-table
+     entry) + edge-hash bit (reference RecordEdge, bochscpu:699-728) set in
+     the per-lane bitmaps
+
+Anything the device path does not implement surfaces as per-lane UNSUPPORTED
+and is single-stepped on the host by the EmuCpu oracle (interp/runner.py) —
+the same "precise slow path backs a fast path" split the reference gets from
+bochscpu vs KVM, collapsed into one machine.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from wtf_tpu.core.results import StatusCode
+from wtf_tpu.cpu import uops as U
+from wtf_tpu.interp.machine import Machine
+from wtf_tpu.interp.uoptable import (
+    F_BASE_REG, F_COND, F_DST_KIND, F_DST_REG, F_IDX_REG, F_LENGTH, F_LOCK,
+    F_OPC, F_OPSIZE, F_REP, F_SCALE, F_SEG, F_SEXT, F_SRCSIZE, F_SRC_KIND,
+    F_SRC_REG, F_SUB, PROBES, UopTable,
+)
+from wtf_tpu.mem.overlay import ensure_page, gather_bytes, split_gpa
+from wtf_tpu.mem.paging import translate
+from wtf_tpu.mem.physmem import MemImage
+
+MASK64 = (1 << 64) - 1
+
+# rflags bits
+_CF, _PF, _AF, _ZF, _SF, _OF = 0x1, 0x4, 0x10, 0x40, 0x80, 0x800
+_TF, _IF, _DF = 0x100, 0x200, 0x400
+FLAGS_ARITH = _CF | _PF | _AF | _ZF | _SF | _OF  # 0x8D5
+
+
+def _u(x: int) -> jnp.ndarray:
+    return jnp.uint64(x & MASK64)
+
+
+def _mix64(z):
+    """splitmix64 mixing steps only — bit-for-bit the reference's RecordEdge
+    RIP hash (bochscpu_backend.cc:705-715); must match utils.hashing.mix64."""
+    z = (z ^ (z >> _u(30))) * _u(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> _u(27))) * _u(0x94D049BB133111EB)
+    return z ^ (z >> _u(31))
+
+
+def _splitmix64(x):
+    return _mix64(x + _u(0x9E3779B97F4A7C15))
+
+
+def _size_mask(nbytes):
+    """nbytes (int32 scalar) -> u64 value mask; >=8 bytes = full mask."""
+    sh = (jnp.minimum(nbytes, 8).astype(jnp.uint64)) * _u(8)
+    partial = (_u(1) << jnp.minimum(sh, _u(63))) - _u(1)
+    return jnp.where(sh >= _u(64), _u(MASK64), partial)
+
+
+def _shl(x, s):
+    """x << s with s (u64) >= 64 yielding 0 (XLA leaves it undefined)."""
+    return jnp.where(s >= _u(64), _u(0), x << jnp.minimum(s, _u(63)))
+
+
+def _shr(x, s):
+    return jnp.where(s >= _u(64), _u(0), x >> jnp.minimum(s, _u(63)))
+
+
+def _sext(val, nbytes):
+    """Sign-extend the low nbytes of val to 64 bits."""
+    sh = ((8 - jnp.minimum(nbytes, 8)).astype(jnp.uint64)) * _u(8)
+    widened = (val << sh).astype(jnp.int64) >> sh.astype(jnp.int64)
+    return widened.astype(jnp.uint64)
+
+
+def _parity_even(r):
+    v = r & _u(0xFF)
+    v = v ^ (v >> _u(4))
+    v = v ^ (v >> _u(2))
+    v = v ^ (v >> _u(1))
+    return (v & _u(1)) == _u(0)
+
+
+def _popcnt(x):
+    x = x - ((x >> _u(1)) & _u(0x5555555555555555))
+    x = (x & _u(0x3333333333333333)) + ((x >> _u(2)) & _u(0x3333333333333333))
+    x = (x + (x >> _u(4))) & _u(0x0F0F0F0F0F0F0F0F)
+    return (x * _u(0x0101010101010101)) >> _u(56)
+
+
+def _bitlen(x):
+    """Position of highest set bit + 1 (0 for x == 0)."""
+    x = x | (x >> _u(1))
+    x = x | (x >> _u(2))
+    x = x | (x >> _u(4))
+    x = x | (x >> _u(8))
+    x = x | (x >> _u(16))
+    x = x | (x >> _u(32))
+    return _popcnt(x)
+
+
+def _umulhi(a, b):
+    m32 = _u(0xFFFFFFFF)
+    a0, a1 = a & m32, a >> _u(32)
+    b0, b1 = b & m32, b >> _u(32)
+    ll = a0 * b0
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    mid = (ll >> _u(32)) + (lh & m32) + (hl & m32)
+    return hh + (lh >> _u(32)) + (hl >> _u(32)) + (mid >> _u(32))
+
+
+def _smulhi(a, b):
+    hi = _umulhi(a, b)
+    hi = hi - jnp.where((a >> _u(63)) != 0, b, _u(0))
+    hi = hi - jnp.where((b >> _u(63)) != 0, a, _u(0))
+    return hi
+
+
+def _mkflags(cf, pf, af, zf, sf, of):
+    def bit(c, v):
+        return jnp.where(c, _u(v), _u(0))
+
+    return (bit(cf, _CF) | bit(pf, _PF) | bit(af, _AF) | bit(zf, _ZF)
+            | bit(sf, _SF) | bit(of, _OF))
+
+
+def _msb(r, opsize):
+    return (r >> ((opsize.astype(jnp.uint64) * _u(8)) - _u(1))) & _u(1)
+
+
+def _flags_add(a, b, r, opsize, carry):
+    m = _size_mask(opsize)
+    am, bm, rm = a & m, b & m, r & m
+    c = jnp.where(carry, _u(1), _u(0))
+    cf = jnp.where(opsize >= 8,
+                   (rm < am) | ((c == _u(1)) & (rm == am)),
+                   (am + bm + c) > m)
+    return _mkflags(
+        cf=cf,
+        pf=_parity_even(rm),
+        af=((a ^ b ^ r) & _u(0x10)) != _u(0),
+        zf=rm == _u(0),
+        sf=_msb(rm, opsize) != _u(0),
+        of=(((a ^ r) & (b ^ r)) >> ((opsize.astype(jnp.uint64) * _u(8)) - _u(1))) & _u(1) != _u(0),
+    )
+
+
+def _flags_sub(a, b, r, opsize, borrow):
+    m = _size_mask(opsize)
+    am, bm, rm = a & m, b & m, r & m
+    cf = jnp.where(borrow, am <= bm, am < bm)
+    return _mkflags(
+        cf=cf,
+        pf=_parity_even(rm),
+        af=((a ^ b ^ r) & _u(0x10)) != _u(0),
+        zf=rm == _u(0),
+        sf=_msb(rm, opsize) != _u(0),
+        of=(((a ^ b) & (a ^ r)) >> ((opsize.astype(jnp.uint64) * _u(8)) - _u(1))) & _u(1) != _u(0),
+    )
+
+
+def _flags_logic(r, opsize):
+    m = _size_mask(opsize)
+    rm = r & m
+    return _mkflags(
+        cf=jnp.bool_(False),
+        pf=_parity_even(rm),
+        af=jnp.bool_(False),
+        zf=rm == _u(0),
+        sf=_msb(rm, opsize) != _u(0),
+        of=jnp.bool_(False),
+    )
+
+
+def _eval_cond(rf, rcx, cc):
+    cf = (rf & _u(_CF)) != 0
+    pf = (rf & _u(_PF)) != 0
+    zf = (rf & _u(_ZF)) != 0
+    sf = (rf & _u(_SF)) != 0
+    of = (rf & _u(_OF)) != 0
+    conds = jnp.stack([
+        of, ~of, cf, ~cf, zf, ~zf, cf | zf, ~(cf | zf),
+        sf, ~sf, pf, ~pf, sf != of, sf == of,
+        zf | (sf != of), ~zf & (sf == of),
+    ])
+    base = conds[jnp.clip(cc, 0, 15)]
+    return jnp.where(cc == 16, rcx == _u(0), base)  # jrcxz
+
+
+# ---------------------------------------------------------------------------
+# register file helpers
+# ---------------------------------------------------------------------------
+
+def _read_reg(gpr, idx, nbytes):
+    high = idx >= U.REG_AH_BASE
+    base = jnp.clip(jnp.where(high, idx - U.REG_AH_BASE, idx), 0, 15)
+    v = gpr[base]
+    return jnp.where(high, (v >> _u(8)) & _u(0xFF), v & _size_mask(nbytes))
+
+
+def _read64(gpr, idx):
+    """Full qword read; REG_NONE (or any out-of-file index) reads 0."""
+    ok = (idx >= 0) & (idx < 16)
+    return jnp.where(ok, gpr[jnp.clip(idx, 0, 15)], _u(0))
+
+
+def _gpr_write(gpr, cond, idx, val, nbytes):
+    """Partial-register merge semantics of cpu/emu.py write_reg: 32-bit
+    writes zero-extend, 8/16-bit merge, AH-view writes hit bits 15:8."""
+    high = idx >= U.REG_AH_BASE
+    base = jnp.clip(jnp.where(high, idx - U.REG_AH_BASE, idx), 0, 15)
+    old = gpr[base]
+    m = _size_mask(nbytes)
+    merged = jnp.where(
+        high, (old & ~_u(0xFF00)) | ((val & _u(0xFF)) << _u(8)),
+        jnp.where(nbytes >= 8, val,
+                  jnp.where(nbytes == 4, val & _u(0xFFFFFFFF),
+                            (old & ~m) | (val & m))))
+    return gpr.at[base].set(jnp.where(cond, merged, old))
+
+
+# ---------------------------------------------------------------------------
+# memory spans (dynamic size <= 16 bytes, overlay-aware, two pages max)
+# ---------------------------------------------------------------------------
+
+def _load16(image, overlay, cr3, addr, size, need):
+    """Read up to 16 bytes at a GVA -> (u8[16], fault, t_first, t_last).
+
+    `size` is a traced int32; bytes >= size carry garbage and must be masked
+    by the caller.  Fault only reported when `need`."""
+    t0 = translate(image, overlay, cr3, addr)
+    t1 = translate(image, overlay, cr3,
+                   addr + (size - 1).astype(jnp.uint64))
+    fault = need & ~(t0.ok & t1.ok)
+    off0 = (addr & _u(0xFFF)).astype(jnp.int32)
+    i = jnp.arange(16, dtype=jnp.int32)
+    on_first = (off0 + i) < 4096
+    iu = i.astype(jnp.uint64)
+    gpa = jnp.where(on_first, t0.gpa + iu,
+                    t1.gpa - (size - 1).astype(jnp.uint64) + iu)
+    data = gather_bytes(image, overlay, gpa, on_first)
+    return data, fault, t0, t1
+
+
+def _store16(image, overlay, t0, t1, addr, size, bytes16, enabled):
+    """Commit up to 16 bytes through the lane overlay (copy-on-write).
+
+    Uses translations computed earlier (so faults were already decided before
+    any state was committed).  Returns (overlay', ok); !ok = overlay full."""
+    pfn0, _ = split_gpa(image, t0.gpa)
+    pfn1, _ = split_gpa(image, t1.gpa)
+    off0 = (addr & _u(0xFFF)).astype(jnp.int32)
+    crosses = (off0 + size) > 4096
+    overlay, row0, ok0 = ensure_page(image, overlay, pfn0, enabled)
+    overlay, row1, ok1 = ensure_page(image, overlay, pfn1, enabled & crosses)
+    ok = ok0 & (ok1 | ~crosses)
+    i = jnp.arange(16, dtype=jnp.int32)
+    on_first = (off0 + i) < 4096
+    off = jnp.where(on_first, off0 + i, off0 + i - 4096)
+    row = jnp.where(on_first, row0, row1)
+    wmask = enabled & ok & (i < size)
+    cur = overlay.data[row, off]
+    data = overlay.data.at[row, off].set(
+        jnp.where(wmask, bytes16, cur))
+    return overlay._replace(data=data), ok
+
+
+def _pack_u64(b, start):
+    """Little-endian u64 from 8 bytes of a u8[16] window (static start)."""
+    sl = b[start:start + 8].astype(jnp.uint64)
+    return jnp.sum(sl << (jnp.arange(8, dtype=jnp.uint64) * _u(8)))
+
+
+def _bytes_of(lo, hi):
+    sh = jnp.arange(8, dtype=jnp.uint64) * _u(8)
+    b_lo = ((lo >> sh) & _u(0xFF)).astype(jnp.uint8)
+    b_hi = ((hi >> sh) & _u(0xFF)).astype(jnp.uint8)
+    return jnp.concatenate([b_lo, b_hi])
+
+
+def _unpack_bytes(lo, hi):
+    """(lo, hi) u64 pair -> u8[16] vector (for SSE byte ops)."""
+    return _bytes_of(lo, hi)
+
+
+def _pack_pair(b16):
+    """u8[16] -> (lo, hi) u64 pair."""
+    return _pack_u64(b16, 0), _pack_u64(b16, 8)
+
+# ---------------------------------------------------------------------------
+# the transition function
+# ---------------------------------------------------------------------------
+
+def uop_lookup(tab: UopTable, rip):
+    """Open-addressed probe (host inserter bounds chains to PROBES) ->
+    entry index or -1 (NEED_DECODE)."""
+    hmask = _u(tab.hash_tab.shape[0] - 1)
+    h = _splitmix64(rip)
+    idx = jnp.int32(-1)
+    for k in range(PROBES):
+        slot = ((h + _u(k)) & hmask).astype(jnp.int32)
+        e = tab.hash_tab[slot]
+        match = (e >= 0) & (tab.rip[jnp.maximum(e, 0)] == rip)
+        idx = jnp.where((idx < 0) & match, e, idx)
+    return idx
+
+
+def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
+    """Advance one lane by one instruction (vmapped over the batch).
+
+    Lanes whose status != RUNNING are a no-op.  `limit` is the instruction
+    budget (u64; 0 = unlimited) -> TIMEDOUT, the deterministic equivalent of
+    the reference's after_execution counter (bochscpu_backend.cc:458-469)."""
+    enabled = st.status == jnp.int32(int(StatusCode.RUNNING))
+    gpr, rip, rf = st.gpr, st.rip, st.rflags
+    overlay = st.overlay
+
+    # -- 1. decode-cache lookup -----------------------------------------
+    idx = uop_lookup(tab, rip)
+    miss = enabled & (idx < 0)
+    idxc = jnp.maximum(idx, 0)
+
+    f = tab.fields[idxc]
+    opc = f[F_OPC]
+    sub = f[F_SUB]
+    cond = f[F_COND]
+    length = f[F_LENGTH]
+    opsize = f[F_OPSIZE]
+    srcsize0 = f[F_SRCSIZE]
+    sext_f = f[F_SEXT]
+    dk = f[F_DST_KIND]
+    dr = f[F_DST_REG]
+    sk = f[F_SRC_KIND]
+    sr = f[F_SRC_REG]
+    breg = f[F_BASE_REG]
+    ireg = f[F_IDX_REG]
+    scale = f[F_SCALE]
+    seg = f[F_SEG]
+    rep = f[F_REP]
+    disp = tab.disp[idxc]
+    imm = tab.imm[idxc]
+
+    opmask = _size_mask(opsize)
+    bits_u = opsize.astype(jnp.uint64) * _u(8)
+    next_rip = rip + length.astype(jnp.uint64)
+
+    # -- 2. breakpoint (pre-execution, like BeforeExecutionHook dispatch) --
+    at_bp = enabled & ~miss & (tab.bp[idxc] == 1) & (st.bp_skip == 0)
+
+    # -- 3. SMC check: live code bytes vs decode-time raw ----------------
+    # Code physical frames come from the decode-time translation (pfn0/pfn1
+    # table columns) so no page walk is needed for fetch; a *mapping* change
+    # without a byte change is not detected (documented divergence — the
+    # oracle flushes uops from dirtied pages the same way).
+    code_off = (rip & _u(0xFFF)).astype(jnp.int32)
+    i16 = jnp.arange(16, dtype=jnp.int32)
+    on_first_c = (code_off + i16) < 4096
+    gpa_c = jnp.where(
+        on_first_c,
+        (tab.pfn0[idxc].astype(jnp.uint64) << _u(12)) + (code_off + i16).astype(jnp.uint64),
+        (tab.pfn1[idxc].astype(jnp.uint64) << _u(12)) + (code_off + i16 - 4096).astype(jnp.uint64),
+    )
+    code = gather_bytes(image, overlay, gpa_c, on_first_c)
+    code_lo = _pack_u64(code, 0)
+    code_hi = _pack_u64(code, 8)
+    lmask_lo = _size_mask(jnp.minimum(length, 8))
+    lmask_hi = jnp.where(length > 8, _size_mask(length - 8), _u(0))
+    smc = enabled & ~miss & ~at_bp & (
+        (((code_lo ^ tab.raw_lo[idxc]) & lmask_lo) != _u(0))
+        | (((code_hi ^ tab.raw_hi[idxc]) & lmask_hi) != _u(0)))
+
+    live = enabled & ~miss & ~at_bp & ~smc
+
+    # -- class predicates -------------------------------------------------
+    def is_(o):
+        return opc == o
+
+    is_string = is_(U.OPC_STRING)
+    s_movs = is_string & (sub == U.STR_MOVS)
+    s_stos = is_string & (sub == U.STR_STOS)
+    s_lods = is_string & (sub == U.STR_LODS)
+    s_scas = is_string & (sub == U.STR_SCAS)
+    s_cmps = is_string & (sub == U.STR_CMPS)
+    rep_on = is_string & (rep != U.REP_NONE)
+    rcx = gpr[1]
+    rep_skip = rep_on & (rcx == _u(0))  # rep w/ rcx=0: architectural no-op
+
+    is_push, is_pop = is_(U.OPC_PUSH), is_(U.OPC_POP)
+    is_pushf, is_popf = is_(U.OPC_PUSHF), is_(U.OPC_POPF)
+    is_call, is_ret = is_(U.OPC_CALL), is_(U.OPC_RET)
+    is_leave = is_(U.OPC_LEAVE)
+    is_sse = is_(U.OPC_SSEMOV) | is_(U.OPC_SSEALU)
+
+    # -- unsupported classes -> host oracle fallback ----------------------
+    rax, rdx = gpr[0], gpr[2]
+    div64_hard = is_(U.OPC_DIV) & (opsize >= 8) & ~jnp.where(
+        sub == U.DIV_U, rdx == _u(0),
+        rdx == jnp.where((rax >> _u(63)) != 0, _u(MASK64), _u(0)))
+    movcr_bad = is_(U.OPC_MOVCR) & ~(
+        (sub == 0) | (sub == 3) | (sub == 4) | (sub == 8)
+        | ((sext_f == 0) & (sub == 2)))
+    unsupported = live & (
+        is_(U.OPC_INVALID) | is_(U.OPC_CPUID) | is_(U.OPC_IRET)
+        | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
+        | is_(U.OPC_STACKSTR) | (is_(U.OPC_RDGSBASE) & (sub != 4))
+        | movcr_bad | div64_hard)
+
+    is_crash = live & (is_(U.OPC_INT) | is_(U.OPC_HLT) | is_(U.OPC_INT1))
+
+    # -- 4a. effective address -------------------------------------------
+    base_val = jnp.where(breg == U.REG_RIP, next_rip, _read64(gpr, breg))
+    idx_val = _read64(gpr, ireg) * scale.astype(jnp.uint64)
+    seg_base = jnp.where(seg == U.SEG_FS, st.fs_base,
+                         jnp.where(seg == U.SEG_GS, st.gs_base, _u(0)))
+    ea = disp + base_val + idx_val + seg_base
+
+    # BT bit-string addressing: register bit index moves the EA by opsize
+    # for every `bits` of signed offset (emu _exec_bt).
+    bt_sel = _read_reg(gpr, sr, opsize)
+    bt_signed = _sext(bt_sel, opsize)
+    log2bits = jnp.where(opsize >= 8, 6, jnp.where(opsize == 4, 5, 4)).astype(jnp.int64)
+    bt_adjust = ((bt_signed.astype(jnp.int64) >> log2bits)
+                 * opsize.astype(jnp.int64)).astype(jnp.uint64)
+    bt_mem_reg = is_(U.OPC_BT) & (dk == U.K_MEM) & (sk == U.K_REG)
+    ea = jnp.where(bt_mem_reg, ea + bt_adjust, ea)
+    bt_off = bt_signed & (bits_u - _u(1))
+
+    # -- 4b. memory roles -------------------------------------------------
+    rsp, rbp, rsi, rdi = gpr[4], gpr[5], gpr[6], gpr[7]
+    srcsize = jnp.where(srcsize0 == 0, opsize, srcsize0)
+
+    l1_need = live & ~unsupported & ~rep_skip & (
+        (sk == U.K_MEM) | is_pop | is_popf | is_ret | is_leave
+        | s_movs | s_lods | s_cmps | s_scas)
+    l1_addr = jnp.where(s_movs | s_lods | s_cmps, rsi,
+               jnp.where(s_scas, rdi,
+                jnp.where(is_pop | is_popf | is_ret, rsp,
+                 jnp.where(is_leave, rbp, ea))))
+    l1_size = jnp.where(is_popf | is_ret | is_leave, 16 // 2,
+               jnp.where(is_pop, opsize,
+                jnp.where(is_string, opsize,
+                 jnp.where(is_sse, opsize, srcsize))))
+    l1_size = jnp.where(is_popf | is_ret | is_leave, 8, l1_size)
+
+    l2_need = live & ~unsupported & ~rep_skip & (
+        ((dk == U.K_MEM) & ~is_sse) | s_cmps)
+    l2_addr = jnp.where(s_cmps, rdi, ea)
+    l2_size = opsize
+
+    b1, fault1, _, _ = _load16(image, overlay, st.cr3, l1_addr, l1_size, l1_need)
+    b2, fault2, _, _ = _load16(image, overlay, st.cr3, l2_addr, l2_size, l2_need)
+    l1_lo, l1_hi = _pack_u64(b1, 0), _pack_u64(b1, 8)
+    l2_lo = _pack_u64(b2, 0)
+
+    # -- 4c. operand values ----------------------------------------------
+    src_raw = jnp.where(sk == U.K_REG, _read_reg(gpr, sr, srcsize),
+               jnp.where(sk == U.K_MEM, l1_lo & _size_mask(srcsize), _u(0)))
+    src_ext = jnp.where(sext_f == 1, _sext(src_raw, srcsize) & opmask,
+                        src_raw & opmask)
+    src_val = jnp.where(sk == U.K_IMM, imm & opmask, src_ext)
+    dst_val = jnp.where(dk == U.K_REG, _read_reg(gpr, dr, opsize),
+               jnp.where(dk == U.K_MEM, l2_lo & opmask, _u(0)))
+
+    # -- 4d. integer ALU classes (mirrors cpu/emu.py exactly) -------------
+    a, b = dst_val, src_val
+    cf_in = (rf & _u(_CF)) != _u(0)
+    cf_in_u = jnp.where(cf_in, _u(1), _u(0))
+
+    # ALU ------------------------------------------------------------
+    r_add = (a + b) & opmask
+    r_adc = (a + b + cf_in_u) & opmask
+    r_sub = (a - b) & opmask
+    r_sbb = (a - b - cf_in_u) & opmask
+    r_and, r_or, r_xor = a & b, a | b, a ^ b
+    alu_r = jnp.select(
+        [sub == U.ALU_ADD, sub == U.ALU_ADC, sub == U.ALU_SUB,
+         sub == U.ALU_SBB, sub == U.ALU_CMP, sub == U.ALU_AND,
+         sub == U.ALU_OR, sub == U.ALU_XOR, sub == U.ALU_TEST],
+        [r_add, r_adc, r_sub, r_sbb, r_sub, r_and, r_or, r_xor, r_and],
+        default=_u(0))
+    alu_flags_add = _flags_add(a, b, alu_r, opsize, (sub == U.ALU_ADC) & cf_in)
+    alu_flags_sub = _flags_sub(a, b, alu_r, opsize, (sub == U.ALU_SBB) & cf_in)
+    alu_flags_logic = _flags_logic(alu_r, opsize)
+    alu_is_add = (sub == U.ALU_ADD) | (sub == U.ALU_ADC)
+    alu_is_sub = (sub == U.ALU_SUB) | (sub == U.ALU_SBB) | (sub == U.ALU_CMP)
+    alu_fl = jnp.where(alu_is_add, alu_flags_add,
+                       jnp.where(alu_is_sub, alu_flags_sub, alu_flags_logic))
+    alu_rf = (rf & ~_u(FLAGS_ARITH)) | alu_fl
+    alu_writes = ~((sub == U.ALU_CMP) | (sub == U.ALU_TEST))
+
+    # SHIFT ----------------------------------------------------------
+    is_shxd = (sub == U.SH_SHLD) | (sub == U.SH_SHRD)
+    cl = gpr[1] & _u(0xFF)
+    cnt_src = jnp.where(is_shxd,
+                        jnp.where(sext_f == 3, imm, cl),
+                        src_val)
+    cnt_mask = jnp.where(opsize >= 8, _u(0x3F), _u(0x1F))
+    count0 = cnt_src & cnt_mask
+    # rcl/rcr rotate through CF over bits+1 positions
+    is_rc = (sub == U.SH_RCL) | (sub == U.SH_RCR)
+    count = jnp.where(is_rc, count0 % (bits_u + _u(1)), count0)
+    # shld/shrd 16-bit with count > bits: arch-undefined; emu reduces mod bits
+    count = jnp.where(is_shxd & (count > bits_u), count % bits_u, count)
+    cnz = count != _u(0)  # count==0: no write, no flag update
+    am = a & opmask
+    sa64 = _sext(a, opsize)
+
+    sh_shl_r = _shl(am, count) & opmask
+    sh_shl_cf = jnp.where(count <= bits_u,
+                          (_shr(am, bits_u - count) & _u(1)) != 0,
+                          jnp.bool_(False))
+    sh_shr_r = _shr(am, count)
+    sh_shr_cf = jnp.where(count <= bits_u,
+                          (_shr(am, count - _u(1)) & _u(1)) != 0,
+                          jnp.bool_(False))
+    sh_sar_r = (sa64.astype(jnp.int64)
+                >> jnp.minimum(count, _u(63)).astype(jnp.int64)
+                ).astype(jnp.uint64) & opmask
+    sh_sar_cf = ((sa64.astype(jnp.int64)
+                  >> jnp.minimum(count - _u(1), _u(63)).astype(jnp.int64)
+                  ).astype(jnp.uint64) & _u(1)) != 0
+    rot_c = count % bits_u
+    rot_cz = rot_c == _u(0)
+    sh_rol_r = jnp.where(rot_cz, am,
+                         (_shl(am, rot_c) | _shr(am, bits_u - rot_c)) & opmask)
+    sh_rol_cf = (sh_rol_r & _u(1)) != 0
+    sh_ror_r = jnp.where(rot_cz, am,
+                         (_shr(am, rot_c) | _shl(am, bits_u - rot_c)) & opmask)
+    sh_ror_cf = _msb(sh_ror_r, opsize) != 0
+    # rcl/rcr: (bits+1)-bit rotate through carry, expressed without u128
+    c1 = count - _u(1)
+    sh_rcl_r = (_shl(am, count) | _shl(cf_in_u, c1)
+                | jnp.where(count > _u(1), _shr(am, bits_u + _u(1) - count), _u(0))
+                ) & opmask
+    sh_rcl_cf = jnp.where(cnz, (_shr(am, bits_u - count) & _u(1)) != 0, cf_in)
+    sh_rcr_r = (_shr(am, count) | _shl(cf_in_u, bits_u - count)
+                | jnp.where(count > _u(1), _shl(am, bits_u + _u(1) - count), _u(0))
+                ) & opmask
+    sh_rcr_cf = jnp.where(cnz, (_shr(am, c1) & _u(1)) != 0, cf_in)
+    filler = _read_reg(gpr, sr, opsize)
+    sh_shld_r = (_shl(am, count) | _shr(filler, bits_u - count)) & opmask
+    sh_shld_cf = (_shr(am, bits_u - count) & _u(1)) != 0
+    sh_shrd_r = (_shr(am, count) | _shl(filler, bits_u - count)) & opmask
+    sh_shrd_cf = (_shr(am, c1) & _u(1)) != 0
+
+    sh_r = jnp.select(
+        [(sub == U.SH_SHL) | (sub == U.SH_SAL), sub == U.SH_SHR,
+         sub == U.SH_SAR, sub == U.SH_ROL, sub == U.SH_ROR,
+         sub == U.SH_RCL, sub == U.SH_RCR, sub == U.SH_SHLD,
+         sub == U.SH_SHRD],
+        [sh_shl_r, sh_shr_r, sh_sar_r, sh_rol_r, sh_ror_r,
+         sh_rcl_r, sh_rcr_r, sh_shld_r, sh_shrd_r], default=_u(0))
+    sh_cf = jnp.select(
+        [(sub == U.SH_SHL) | (sub == U.SH_SAL), sub == U.SH_SHR,
+         sub == U.SH_SAR, sub == U.SH_ROL, sub == U.SH_ROR,
+         sub == U.SH_RCL, sub == U.SH_RCR, sub == U.SH_SHLD,
+         sub == U.SH_SHRD],
+        [sh_shl_cf, sh_shr_cf, sh_sar_cf, sh_rol_cf, sh_ror_cf,
+         sh_rcl_cf, sh_rcr_cf, sh_shld_cf, sh_shrd_cf],
+        default=jnp.bool_(False))
+    count1 = count == _u(1)
+    of_keep = (rf & _u(_OF)) != 0
+    sh_msb = _msb(sh_r, opsize) != 0
+    sh_of = jnp.select(
+        [(sub == U.SH_SHL) | (sub == U.SH_SAL), sub == U.SH_SHR,
+         sub == U.SH_SAR, sub == U.SH_ROL, sub == U.SH_ROR,
+         sub == U.SH_RCL, sub == U.SH_RCR,
+         sub == U.SH_SHLD, sub == U.SH_SHRD],
+        [jnp.where(count1, sh_msb != sh_cf, of_keep),
+         jnp.where(count1, _msb(am, opsize) != 0, of_keep),
+         jnp.where(count1, jnp.bool_(False), of_keep),
+         jnp.where(count1, sh_msb != sh_cf, of_keep),
+         jnp.where(count1,
+                   sh_msb != (((sh_ror_r >> (bits_u - _u(2))) & _u(1)) != 0),
+                   of_keep),
+         jnp.where(count1, sh_msb != sh_cf, of_keep),
+         jnp.where(count1, (_msb(am, opsize) != 0) != cf_in, of_keep),
+         jnp.where(count1, (_msb(sh_shld_r ^ am, opsize)) != 0, jnp.bool_(False)),
+         jnp.where(count1, (_msb(sh_shrd_r ^ am, opsize)) != 0, jnp.bool_(False))],
+        default=of_keep)
+    sh_full = _mkflags(sh_cf, _parity_even(sh_r), jnp.bool_(False),
+                       sh_r == _u(0), sh_msb, sh_of)
+    # rcl/rcr update only CF|OF; others CF|OF|ZF|SF|PF (AF undefined->0)
+    sh_mask = jnp.where(is_rc, _u(_CF | _OF), _u(FLAGS_ARITH))
+    sh_rf = jnp.where(cnz, (rf & ~sh_mask) | (sh_full & sh_mask), rf)
+    sh_writes = cnz
+
+    # UNARY ----------------------------------------------------------
+    un_inc_r = (a + _u(1)) & opmask
+    un_dec_r = (a - _u(1)) & opmask
+    un_neg_r = (_u(0) - a) & opmask
+    un_not_r = (~a) & opmask
+    un_r = jnp.select(
+        [sub == U.UN_INC, sub == U.UN_DEC, sub == U.UN_NOT, sub == U.UN_NEG],
+        [un_inc_r, un_dec_r, un_not_r, un_neg_r], default=_u(0))
+    un_fl = jnp.where(
+        sub == U.UN_INC, _flags_add(a, _u(1), un_inc_r, opsize, jnp.bool_(False)),
+        jnp.where(sub == U.UN_DEC,
+                  _flags_sub(a, _u(1), un_dec_r, opsize, jnp.bool_(False)),
+                  _flags_sub(_u(0), a, un_neg_r, opsize, jnp.bool_(False))))
+    # inc/dec preserve CF; neg: CF = (a != 0)
+    un_cf = jnp.where((sub == U.UN_INC) | (sub == U.UN_DEC), cf_in,
+                      (a & opmask) != _u(0))
+    un_rf = jnp.where(sub == U.UN_NOT, rf,
+                      (rf & ~_u(FLAGS_ARITH)) | (un_fl & ~_u(_CF))
+                      | jnp.where(un_cf, _u(_CF), _u(0)))
+
+    # MUL ------------------------------------------------------------
+    sa_s, sb_s = _sext(a, opsize), _sext(b, opsize)
+    mul2_a = jnp.where(sext_f == 2, b, a)          # 3-op: r/m * imm
+    mul2_b = jnp.where(sext_f == 2, imm & opmask, b)
+    mul2_sa, mul2_sb = _sext(mul2_a, opsize), _sext(mul2_b, opsize)
+    mul2_lo = (mul2_sa * mul2_sb) & opmask
+    mul2_wide_small = (mul2_sa.astype(jnp.int64) * mul2_sb.astype(jnp.int64))
+    mul2_of_small = mul2_wide_small != _sext(mul2_lo, opsize).astype(jnp.int64)
+    mul2_hi64 = _smulhi(mul2_sa, mul2_sb)
+    mul2_of_64 = mul2_hi64 != jnp.where(
+        (mul2_lo >> _u(63)) != 0, _u(MASK64), _u(0))
+    mul2_of = jnp.where(opsize >= 8, mul2_of_64, mul2_of_small)
+
+    rax_op = _read_reg(gpr, jnp.int32(0), opsize)
+    sax = _sext(rax_op, opsize)
+    # unsigned widening
+    muw_lo_small = (rax_op * b) & opmask
+    muw_hi_small = _shr(rax_op * b, bits_u) & opmask
+    muw_lo_64 = rax_op * b
+    muw_hi_64 = _umulhi(rax_op, b)
+    muw_u_lo = jnp.where(opsize >= 8, muw_lo_64, muw_lo_small)
+    muw_u_hi = jnp.where(opsize >= 8, muw_hi_64, muw_hi_small)
+    muw_u_of = muw_u_hi != _u(0)
+    # signed widening
+    muw_s_full_small = sax.astype(jnp.int64) * sb_s.astype(jnp.int64)
+    muw_s_lo_small = muw_s_full_small.astype(jnp.uint64) & opmask
+    muw_s_hi_small = _shr(muw_s_full_small.astype(jnp.uint64), bits_u) & opmask
+    muw_s_lo_64 = sax * sb_s
+    muw_s_hi_64 = _smulhi(sax, sb_s)
+    muw_s_lo = jnp.where(opsize >= 8, muw_s_lo_64, muw_s_lo_small)
+    muw_s_hi = jnp.where(opsize >= 8, muw_s_hi_64, muw_s_hi_small)
+    muw_s_of = jnp.where(
+        opsize >= 8,
+        muw_s_hi_64 != jnp.where((muw_s_lo_64 >> _u(63)) != 0, _u(MASK64), _u(0)),
+        muw_s_full_small != _sext(muw_s_lo_small, opsize).astype(jnp.int64))
+    mul_wide_s = sub == U.MUL_WIDE_S
+    muw_lo = jnp.where(mul_wide_s, muw_s_lo, muw_u_lo)
+    muw_hi = jnp.where(mul_wide_s, muw_s_hi, muw_u_hi)
+    muw_of = jnp.where(mul_wide_s, muw_s_of, muw_u_of)
+    is_mul2 = sub == U.MUL_2OP
+    mul_of = jnp.where(is_mul2, mul2_of, muw_of)
+    mul2_msb = _msb(mul2_lo, opsize) != 0
+    mul_rf = jnp.where(
+        is_mul2,
+        (rf & ~_u(FLAGS_ARITH)) | _mkflags(
+            mul_of, _parity_even(mul2_lo), jnp.bool_(False),
+            jnp.bool_(False), mul2_msb, mul_of),
+        (rf & ~_u(_CF | _OF))
+        | jnp.where(mul_of, _u(_CF | _OF), _u(0)))
+
+    # DIV (device path: dividend fits in 64 bits; else host fallback) --
+    d_lo = rax_op
+    d_hi = _read_reg(gpr, jnp.int32(2), opsize)
+    dividend_u = jnp.where(opsize == 1, _read_reg(gpr, jnp.int32(0), jnp.int32(2)),
+                           jnp.where(opsize >= 8, d_lo,
+                                     _shl(d_hi, bits_u) | d_lo))
+    div_b = b & opmask
+    div_bz = div_b == _u(0)
+    safe_b = jnp.where(div_bz, _u(1), div_b)
+    q_u = dividend_u // safe_b
+    rem_u = dividend_u % safe_b
+    # signed: sign-extend the (bits*2 <=64 or rdx:rax w/ rdx=sign) dividend
+    sdividend = jnp.where(
+        opsize == 1, _sext(dividend_u, jnp.int32(2)),
+        jnp.where(opsize == 2, _sext(dividend_u, jnp.int32(4)),
+                  jnp.where(opsize == 4, _sext(dividend_u, jnp.int32(8)),
+                            d_lo))).astype(jnp.int64)
+    sb_div = _sext(div_b, opsize).astype(jnp.int64)
+    safe_sb = jnp.where(div_bz, jnp.int64(1), sb_div)
+    # guard INT64_MIN / -1 (hardware #DE; lax.div would trap-free wrap)
+    int_min_edge = (sdividend == jnp.int64(-2**63)) & (sb_div == jnp.int64(-1))
+    q_s = lax.div(sdividend, jnp.where(int_min_edge, jnp.int64(1), safe_sb))
+    rem_s = lax.rem(sdividend, jnp.where(int_min_edge, jnp.int64(1), safe_sb))
+    is_sdiv = sub == U.DIV_S
+    half_mask = _shr(opmask, _u(1))  # max positive quotient
+    q_over = jnp.where(
+        is_sdiv,
+        (q_s > half_mask.astype(jnp.int64))
+        | (q_s < (-(half_mask.astype(jnp.int64)) - 1)) | int_min_edge,
+        q_u > opmask)
+    de = live & is_(U.OPC_DIV) & ~div64_hard & (div_bz | q_over)
+    div_q = jnp.where(is_sdiv, q_s.astype(jnp.uint64), q_u) & opmask
+    div_rem = jnp.where(is_sdiv, rem_s.astype(jnp.uint64), rem_u) & opmask
+
+    # CONVERT ---------------------------------------------------------
+    half_bytes = jnp.maximum(opsize // 2, 1)
+    cvt_widen = _sext(rax_op & _size_mask(half_bytes), half_bytes) & opmask
+    cvt_sign = jnp.where(_msb(rax_op, opsize) != 0, opmask, _u(0))
+
+    # BT --------------------------------------------------------------
+    bt_imm_off = imm & (bits_u - _u(1))
+    bt_offset = jnp.where(sk == U.K_IMM, bt_imm_off, bt_off)
+    bt_val = dst_val
+    bt_bit = (_shr(bt_val, bt_offset) & _u(1)) != 0
+    bt_one = _shl(_u(1), bt_offset)
+    bt_r = jnp.select(
+        [sub == U.BT_BT, sub == U.BT_BTS, sub == U.BT_BTR, sub == U.BT_BTC],
+        [bt_val, bt_val | bt_one, bt_val & ~bt_one, bt_val ^ bt_one],
+        default=bt_val)
+    bt_rf = (rf & ~_u(_CF)) | jnp.where(bt_bit, _u(_CF), _u(0))
+    bt_writes = sub != U.BT_BT
+
+    # BITSCAN ---------------------------------------------------------
+    bs_src = src_val & opmask
+    bs_zero = bs_src == _u(0)
+    bs_pop = _popcnt(bs_src)
+    bs_tz = _popcnt((~bs_src) & (bs_src - _u(1)))
+    bs_len = _bitlen(bs_src)
+    bs_lz = bits_u - bs_len
+    bs_r = jnp.select(
+        [sub == U.BS_POPCNT, sub == U.BS_TZCNT, sub == U.BS_LZCNT,
+         sub == U.BS_BSF, sub == U.BS_BSR],
+        [bs_pop,
+         jnp.where(bs_zero, bits_u, bs_tz),
+         jnp.where(bs_zero, bits_u, bs_lz),
+         bs_tz, bs_len - _u(1)], default=_u(0))
+    bs_writes = ~(((sub == U.BS_BSF) | (sub == U.BS_BSR)) & bs_zero)
+    bs_rf = jnp.select(
+        [sub == U.BS_POPCNT,
+         (sub == U.BS_TZCNT) | (sub == U.BS_LZCNT)],
+        [(rf & ~_u(FLAGS_ARITH)) | jnp.where(bs_zero, _u(_ZF), _u(0)),
+         (rf & ~_u(_CF | _ZF))
+         | jnp.where(bs_zero, _u(_CF), _u(0))
+         | jnp.where(bs_r == _u(0), _u(_ZF), _u(0))],
+        default=(rf & ~_u(_ZF)) | jnp.where(bs_zero, _u(_ZF), _u(0)))
+
+    # CMPXCHG / XADD --------------------------------------------------
+    cx_acc = rax_op
+    cx_eq = cx_acc == dst_val
+    cx_store = jnp.where(cx_eq, _read_reg(gpr, sr, opsize), dst_val)
+    cx_rf = (rf & ~_u(FLAGS_ARITH)) | _flags_sub(
+        cx_acc, dst_val, (cx_acc - dst_val) & opmask, opsize, jnp.bool_(False))
+    xadd_r = (dst_val + _read_reg(gpr, sr, opsize)) & opmask
+    xadd_rf = (rf & ~_u(FLAGS_ARITH)) | _flags_add(
+        dst_val, _read_reg(gpr, sr, opsize), xadd_r, opsize, jnp.bool_(False))
+
+    # BSWAP -----------------------------------------------------------
+    bsw_in = dst_val & opmask
+    sh8 = jnp.arange(8, dtype=jnp.uint64) * _u(8)
+    bsw_bytes = (bsw_in >> sh8) & _u(0xFF)
+    nb_u = opsize.astype(jnp.uint64)
+    rev_sh = jnp.where(jnp.arange(8, dtype=jnp.uint64) < nb_u,
+                       (nb_u - _u(1) - jnp.arange(8, dtype=jnp.uint64)) * _u(8),
+                       _u(0))
+    bsw_r = jnp.sum(jnp.where(jnp.arange(8, dtype=jnp.uint64) < nb_u,
+                              bsw_bytes << rev_sh, _u(0)))
+
+    # 8-bit widening mul writes the full product to AX (emu _exec_mul)
+    muw_prod16 = jnp.where(mul_wide_s,
+                           (sax * sb_s) & _u(0xFFFF),
+                           (rax_op * b) & _u(0xFFFF))
+
+    # STRING (one element per step; REP iterates by re-executing) ------
+    df_set = (rf & _u(_DF)) != 0
+    str_delta = jnp.where(df_set, _u(0) - opsize.astype(jnp.uint64),
+                          opsize.astype(jnp.uint64))
+    str_a = jnp.where(s_cmps, l1_lo & opmask,
+                      rax_op)                       # scas: rax, cmps: [rsi]
+    str_b = jnp.where(s_cmps, l2_lo & opmask, l1_lo & opmask)  # [rdi]
+    str_cmp_r = (str_a - str_b) & opmask
+    str_rf = (rf & ~_u(FLAGS_ARITH)) | _flags_sub(
+        str_a, str_b, str_cmp_r, opsize, jnp.bool_(False))
+    str_zf_new = (str_cmp_r == _u(0))
+    rcx_dec = rcx - _u(1)
+    str_cc_done = (s_scas | s_cmps) & jnp.where(
+        rep == U.REP_REP, ~str_zf_new, str_zf_new)
+    str_done = jnp.where(rep_on,
+                         rep_skip | (rcx_dec == _u(0)) | str_cc_done,
+                         jnp.bool_(True))
+    str_upd = live & is_string & ~unsupported & ~rep_skip
+
+    # control flow -----------------------------------------------------
+    cc_true = _eval_cond(rf, rcx, cond)
+    jmp_target = jnp.where(sk == U.K_IMM, next_rip + imm, src_val)
+    ret_target = l1_lo
+    syscall_entry = sub == 0
+
+    # PUSHF / POPF / FLAGOP -------------------------------------------
+    popf_rf = (l1_lo & _u(0x40FD5)) | _u(0x2)
+    flagop_rf = jnp.select(
+        [sub == U.FL_CLC, sub == U.FL_STC, sub == U.FL_CMC,
+         sub == U.FL_CLD, sub == U.FL_STD, sub == U.FL_CLI,
+         sub == U.FL_STI, sub == U.FL_SAHF],
+        [rf & ~_u(_CF), rf | _u(_CF), rf ^ _u(_CF),
+         rf & ~_u(_DF), rf | _u(_DF), rf & ~_u(_IF),
+         rf | _u(_IF),
+         (rf & ~_u(0xD5)) | (_read_reg(gpr, jnp.int32(U.REG_AH_BASE), jnp.int32(1)) & _u(0xD5)) | _u(0x2)],
+        default=rf)  # LAHF leaves rflags alone (writes AH instead)
+    lahf_val = (rf & _u(0xD7)) | _u(0x2)
+
+    # RDTSC / RDRAND / XGETBV / SYSCALL / SWAPGS / MOVCR ---------------
+    tsc_now = st.tsc + st.icount
+    rdrand_next = _splitmix64(st.rdrand)
+    rdrand_rf = (rf & ~_u(FLAGS_ARITH)) | _u(_CF)
+    syscall_rf = (rf & ~(st.sfmask | _u(_TF))) | _u(0x2)
+    sysret_rf = (gpr[11] & _u(0x3C7FD7)) | _u(0x2)
+    cr_read = jnp.select(
+        [sub == 0, sub == 2, sub == 3, sub == 4, sub == 8],
+        [st.cr0, _u(0), st.cr3, st.cr4, st.cr8], default=_u(0))
+    movcr_is_write = is_(U.OPC_MOVCR) & (sext_f != 0)
+    cr_wval = _read_reg(gpr, sr, jnp.int32(8))
+
+    # SSE --------------------------------------------------------------
+    xmm = st.xmm
+    x_dst_lo, x_dst_hi = xmm[jnp.clip(dr, 0, 15), 0], xmm[jnp.clip(dr, 0, 15), 1]
+    x_src_lo = jnp.where(sk == U.K_XMM, xmm[jnp.clip(sr, 0, 15), 0], l1_lo)
+    x_src_hi = jnp.where(sk == U.K_XMM, xmm[jnp.clip(sr, 0, 15), 1], l1_hi)
+    is_ssemov = is_(U.OPC_SSEMOV)
+    is_ssealu = is_(U.OPC_SSEALU)
+    # movd/movq gpr->xmm (sub 1): value zero-extended into the register
+    gpr_to_x = _read_reg(gpr, sr, opsize)
+    ssm_in_lo = jnp.where(sub == 1, gpr_to_x, x_src_lo)
+    ssm_in_hi = jnp.where(sub == 1, _u(0),
+                          jnp.where(opsize >= 16, x_src_hi, _u(0)))
+    # movss/movsd xmm,xmm merge low lanes; loads and movq (sub 3) zero upper
+    ssm_merge = (sk == U.K_XMM) & (opsize < 16) & (sub != 3) & (sub != 1)
+    sz_mask_x = _size_mask(opsize)  # opsize 4/8/16
+    ssm_lo = jnp.where(opsize >= 8, ssm_in_lo,
+                       jnp.where(ssm_merge,
+                                 (x_dst_lo & ~sz_mask_x) | (ssm_in_lo & sz_mask_x),
+                                 ssm_in_lo & sz_mask_x))
+    ssm_hi = jnp.where(opsize >= 16, ssm_in_hi,
+                       jnp.where(ssm_merge, x_dst_hi, _u(0)))
+    ssm_hi = jnp.where(sub == 1, _u(0), ssm_hi)
+
+    # byte-level SSE ALU on unpacked u8[16] vectors
+    ba = _unpack_bytes(x_dst_lo, x_dst_hi)
+    bb = jnp.where(sk == U.K_XMM,
+                   _unpack_bytes(xmm[jnp.clip(sr, 0, 15), 0],
+                                 xmm[jnp.clip(sr, 0, 15), 1]),
+                   b1)
+    i16u = jnp.arange(16, dtype=jnp.int32)
+    eq_b = (ba == bb)
+    # word/dword equality via group-reduction of byte equality
+    eq_w16 = eq_b[(i16u // 2) * 2] & eq_b[(i16u // 2) * 2 + 1]
+    eq_d16 = (eq_b[(i16u // 4) * 4] & eq_b[(i16u // 4) * 4 + 1]
+              & eq_b[(i16u // 4) * 4 + 2] & eq_b[(i16u // 4) * 4 + 3])
+    pshufd_sel = ((imm >> ((i16u // 4).astype(jnp.uint64) * _u(2))) & _u(3)
+                  ).astype(jnp.int32)
+    pshufd_idx = pshufd_sel * 4 + (i16u % 4)
+    pslldq_n = jnp.minimum(imm, _u(16)).astype(jnp.int32)
+    psll_idx = jnp.clip(i16u - pslldq_n, 0, 15)
+    psrl_idx = jnp.clip(i16u + pslldq_n, 0, 15)
+    sse_bytes = jnp.select(
+        [sub == U.SSE_PXOR, sub == U.SSE_XORPS, sub == U.SSE_POR,
+         sub == U.SSE_PAND, sub == U.SSE_PANDN,
+         sub == U.SSE_PCMPEQB, sub == U.SSE_PCMPEQW, sub == U.SSE_PCMPEQD,
+         sub == U.SSE_PSUBB, sub == U.SSE_PADDB, sub == U.SSE_PMINUB,
+         sub == U.SSE_PUNPCKLQDQ, sub == U.SSE_PSHUFD,
+         sub == U.SSE_PSLLDQ, sub == U.SSE_PSRLDQ],
+        [ba ^ bb, ba ^ bb, ba | bb, ba & bb, (~ba) & bb,
+         jnp.where(eq_b, jnp.uint8(0xFF), jnp.uint8(0)),
+         jnp.where(eq_w16, jnp.uint8(0xFF), jnp.uint8(0)),
+         jnp.where(eq_d16, jnp.uint8(0xFF), jnp.uint8(0)),
+         ba - bb, ba + bb, jnp.minimum(ba, bb),
+         jnp.where(i16u < 8, ba, bb[jnp.clip(i16u - 8, 0, 15)]),
+         bb[pshufd_idx],
+         jnp.where(i16u >= pslldq_n, ba[psll_idx], jnp.uint8(0)),
+         jnp.where(i16u + pslldq_n < 16, ba[psrl_idx], jnp.uint8(0))],
+        default=ba)
+    sse_out_lo, sse_out_hi = _pack_pair(sse_bytes)
+    # pmovmskb: sign bit of each src byte -> gpr bit i
+    bsrc_msk = _unpack_bytes(xmm[jnp.clip(sr, 0, 15), 0],
+                             xmm[jnp.clip(sr, 0, 15), 1])
+    pmov_mask = jnp.sum(
+        jnp.where((bsrc_msk & jnp.uint8(0x80)) != 0,
+                  _u(1) << i16u.astype(jnp.uint64), _u(0)))
+    # ptest
+    ptest_zf = ((x_dst_lo & x_src_lo) == _u(0)) & ((x_dst_hi & x_src_hi) == _u(0))
+    ptest_cf = (((~x_dst_lo) & x_src_lo) == _u(0)) & (((~x_dst_hi) & x_src_hi) == _u(0))
+    ptest_rf = (rf & ~_u(FLAGS_ARITH)) | _mkflags(
+        ptest_cf, jnp.bool_(False), jnp.bool_(False), ptest_zf,
+        jnp.bool_(False), jnp.bool_(False))
+
+    # -- 5. result routing -------------------------------------------------
+    cc01 = jnp.where(cc_true, _u(1), _u(0))
+    is_mul = is_(U.OPC_MUL)
+    is_swapgs = is_(U.OPC_RDGSBASE) & (sub == 4)
+    i0, i1_, i2_, i4_, i5_, i11_ = (jnp.int32(0), jnp.int32(1), jnp.int32(2),
+                                    jnp.int32(4), jnp.int32(5), jnp.int32(11))
+
+    opc_list = lambda pairs, default: jnp.select(  # noqa: E731
+        [p[0] for p in pairs], [p[1] for p in pairs], default=default)
+
+    # primary register write (the generic `store_dst` reg case of emu.py)
+    w1_cond = opc_list([
+        (is_(U.OPC_MOV), dk == U.K_REG),
+        (is_(U.OPC_LEA), jnp.bool_(True)),
+        (is_(U.OPC_ALU), alu_writes & (dk == U.K_REG)),
+        (is_(U.OPC_SHIFT), sh_writes & (dk == U.K_REG)),
+        (is_(U.OPC_UNARY), dk == U.K_REG),
+        (is_mul, jnp.bool_(True)),
+        (is_(U.OPC_DIV), jnp.bool_(True)),
+        (is_pop, dk == U.K_REG),
+        (is_(U.OPC_SETCC), dk == U.K_REG),
+        (is_(U.OPC_CMOVCC), jnp.bool_(True)),
+        (is_(U.OPC_BT), bt_writes & (dk == U.K_REG)),
+        (is_(U.OPC_BITSCAN), bs_writes),
+        (is_(U.OPC_CONVERT), jnp.bool_(True)),
+        (is_(U.OPC_FLAGOP), sub == U.FL_LAHF),
+        (is_(U.OPC_BSWAP), jnp.bool_(True)),
+        (is_(U.OPC_CMPXCHG), dk == U.K_REG),
+        (is_(U.OPC_XADD), dk == U.K_REG),
+        (is_leave, jnp.bool_(True)),
+        (is_(U.OPC_RDTSC), jnp.bool_(True)),
+        (is_(U.OPC_RDRAND), jnp.bool_(True)),
+        (is_(U.OPC_XGETBV), jnp.bool_(True)),
+        (is_string, s_lods & ~rep_skip),
+        (is_(U.OPC_SYSCALL), syscall_entry),
+        (is_(U.OPC_MOVCR), ~movcr_is_write),
+        (is_(U.OPC_XCHG), dk == U.K_REG),
+        (is_ssemov, (sub == 2) & (dk == U.K_REG)),
+        (is_ssealu, sub == U.SSE_PMOVMSKB),
+    ], jnp.bool_(False))
+    w1_idx = opc_list([
+        (is_mul, jnp.where(is_mul2, dr, i0)),
+        (is_(U.OPC_DIV), i0),
+        (is_(U.OPC_CONVERT), jnp.where(sub == 0, i0, i2_)),
+        (is_(U.OPC_FLAGOP), jnp.int32(U.REG_AH_BASE)),
+        (is_leave, i5_),
+        (is_(U.OPC_RDTSC) | is_(U.OPC_XGETBV), i0),
+        (is_string, i0),
+        (is_(U.OPC_SYSCALL), i11_),
+    ], dr)
+    w1_val = opc_list([
+        (is_(U.OPC_MOV), src_val),
+        (is_(U.OPC_LEA), ea),
+        (is_(U.OPC_ALU), alu_r),
+        (is_(U.OPC_SHIFT), sh_r),
+        (is_(U.OPC_UNARY), un_r),
+        (is_mul, jnp.where(is_mul2, mul2_lo,
+                           jnp.where(opsize == 1, muw_prod16, muw_lo))),
+        (is_(U.OPC_DIV), div_q),
+        (is_pop, l1_lo & opmask),
+        (is_(U.OPC_SETCC), cc01),
+        (is_(U.OPC_CMOVCC), jnp.where(cc_true, src_val, dst_val)),
+        (is_(U.OPC_BT), bt_r),
+        (is_(U.OPC_BITSCAN), bs_r),
+        (is_(U.OPC_CONVERT), jnp.where(sub == 0, cvt_widen, cvt_sign)),
+        (is_(U.OPC_FLAGOP), lahf_val),
+        (is_(U.OPC_BSWAP), bsw_r),
+        (is_(U.OPC_CMPXCHG), cx_store),
+        (is_(U.OPC_XADD), xadd_r),
+        (is_leave, l1_lo),
+        (is_(U.OPC_RDTSC), tsc_now & _u(0xFFFFFFFF)),
+        (is_(U.OPC_RDRAND), rdrand_next & opmask),
+        (is_(U.OPC_XGETBV), _u(7)),
+        (is_string, l1_lo & opmask),
+        (is_(U.OPC_SYSCALL), rf & ~_u(0x10000)),
+        (is_(U.OPC_MOVCR), cr_read),
+        (is_(U.OPC_XCHG), src_val),
+        (is_ssemov, xmm[jnp.clip(sr, 0, 15), 0]),
+        (is_ssealu, pmov_mask),
+    ], _u(0))
+    w1_size = opc_list([
+        (is_mul, jnp.where(is_mul2, opsize,
+                           jnp.where(opsize == 1, jnp.int32(2), opsize))),
+        (is_(U.OPC_FLAGOP), jnp.int32(1)),
+        (is_leave | is_(U.OPC_RDTSC) | is_(U.OPC_SYSCALL)
+         | is_(U.OPC_MOVCR), jnp.int32(8)),
+        (is_(U.OPC_XGETBV) | is_ssealu, jnp.int32(4)),
+    ], opsize)
+
+    # secondary register write
+    w2_cond = opc_list([
+        (is_(U.OPC_XCHG), sk == U.K_REG),
+        (is_mul, ~is_mul2 & (opsize > 1)),
+        (is_(U.OPC_DIV), jnp.bool_(True)),
+        (is_(U.OPC_CMPXCHG), ~cx_eq),
+        (is_(U.OPC_XADD), jnp.bool_(True)),
+        (is_(U.OPC_RDTSC) | is_(U.OPC_XGETBV), jnp.bool_(True)),
+        (is_(U.OPC_SYSCALL), syscall_entry),
+    ], jnp.bool_(False))
+    w2_idx = opc_list([
+        (is_(U.OPC_XCHG) | is_(U.OPC_XADD), sr),
+        (is_(U.OPC_DIV), jnp.where(opsize == 1,
+                                   jnp.int32(U.REG_AH_BASE), i2_)),
+        (is_(U.OPC_CMPXCHG), i0),
+        (is_(U.OPC_SYSCALL), i1_),
+    ], i2_)
+    w2_val = opc_list([
+        (is_(U.OPC_XCHG) | is_(U.OPC_XADD) | is_(U.OPC_CMPXCHG), dst_val),
+        (is_mul, muw_hi),
+        (is_(U.OPC_DIV), div_rem),
+        (is_(U.OPC_RDTSC), tsc_now >> _u(32)),
+        (is_(U.OPC_XGETBV), _u(0)),
+        (is_(U.OPC_SYSCALL), next_rip),
+    ], _u(0))
+    w2_size = opc_list([
+        (is_(U.OPC_DIV), jnp.where(opsize == 1, jnp.int32(1), opsize)),
+        (is_(U.OPC_RDTSC) | is_(U.OPC_SYSCALL), jnp.int32(8)),
+        (is_(U.OPC_XGETBV), jnp.int32(4)),
+    ], opsize)
+
+    # rsp adjustment
+    push_size = jnp.where(is_pushf | is_call, jnp.int32(8), opsize)
+    w3_cond = is_push | is_pushf | is_call | is_pop | is_popf | is_ret | is_leave
+    w3_val = opc_list([
+        (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
+        (is_pop, rsp + opsize.astype(jnp.uint64)),
+        (is_popf, rsp + _u(8)),
+        (is_ret, rsp + _u(8) + imm),
+        (is_leave, rbp + _u(8)),
+    ], rsp)
+
+    # string pointer/count updates
+    w4_cond = (s_movs | s_lods | s_cmps) & ~rep_skip   # rsi
+    w5_cond = (s_movs | s_stos | s_scas | s_cmps) & ~rep_skip  # rdi
+    w6_cond = rep_on & ~rep_skip                        # rcx
+
+    # -- memory store ------------------------------------------------------
+    mem_class_writes = opc_list([
+        (is_(U.OPC_MOV), jnp.bool_(True)),
+        (is_(U.OPC_ALU), alu_writes),
+        (is_(U.OPC_SHIFT), sh_writes),
+        (is_(U.OPC_UNARY) | is_(U.OPC_SETCC) | is_(U.OPC_CMPXCHG)
+         | is_(U.OPC_XADD) | is_pop | is_(U.OPC_XCHG) | is_ssemov,
+         jnp.bool_(True)),
+        (is_(U.OPC_BT), bt_writes),
+    ], jnp.bool_(False))
+    st_need = live & ~unsupported & ~rep_skip & (
+        ((dk == U.K_MEM) & mem_class_writes)
+        | is_push | is_pushf | is_call | s_movs | s_stos)
+    st_addr = opc_list([
+        (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
+        (s_movs | s_stos, rdi),
+    ], ea)
+    st_size = jnp.where(is_pushf | is_call, jnp.int32(8),
+                        jnp.where(is_push, opsize, opsize))
+    st_lo = opc_list([
+        (is_(U.OPC_MOV) | is_push, src_val),
+        (is_(U.OPC_ALU), alu_r),
+        (is_(U.OPC_SHIFT), sh_r),
+        (is_(U.OPC_UNARY), un_r),
+        (is_(U.OPC_SETCC), cc01),
+        (is_(U.OPC_BT), bt_r),
+        (is_(U.OPC_CMPXCHG), cx_store),
+        (is_(U.OPC_XADD), xadd_r),
+        (is_pop, l1_lo & opmask),
+        (is_(U.OPC_XCHG), src_val),
+        (is_call, next_rip),
+        (is_pushf, rf | _u(0x2)),
+        (s_stos, rax_op),
+        (is_ssemov, xmm[jnp.clip(sr, 0, 15), 0]),
+    ], _u(0))
+    st_hi = jnp.where(is_ssemov, xmm[jnp.clip(sr, 0, 15), 1], _u(0))
+    st_bytes = jnp.where(s_movs, b1, _bytes_of(st_lo, st_hi))
+
+    ts0 = translate(image, overlay, st.cr3, st_addr)
+    ts1 = translate(image, overlay, st.cr3,
+                    st_addr + (st_size - 1).astype(jnp.uint64))
+    store_fault = st_need & ~(ts0.ok & ts1.ok & ts0.writable & ts1.writable)
+
+    page_fault = live & ~unsupported & ~is_crash & (fault1 | fault2 | store_fault)
+    commit_pre = live & ~unsupported & ~is_crash & ~de & ~page_fault
+
+    overlay, store_ok = _store16(image, overlay, ts0, ts1, st_addr, st_size,
+                                 st_bytes, st_need & commit_pre)
+    ovf = st_need & commit_pre & ~store_ok
+    commit = commit_pre & ~ovf
+
+    # -- register file application (order: rsp/rsi/rdi/rcx, aux, primary) --
+    new_gpr = gpr
+    new_gpr = new_gpr.at[4].set(jnp.where(commit & w3_cond, w3_val, new_gpr[4]))
+    new_gpr = new_gpr.at[6].set(jnp.where(commit & w4_cond,
+                                          rsi + str_delta, new_gpr[6]))
+    new_gpr = new_gpr.at[7].set(jnp.where(commit & w5_cond,
+                                          rdi + str_delta, new_gpr[7]))
+    new_gpr = new_gpr.at[1].set(jnp.where(commit & w6_cond,
+                                          rcx_dec, new_gpr[1]))
+    new_gpr = _gpr_write(new_gpr, commit & w2_cond, w2_idx, w2_val, w2_size)
+    new_gpr = _gpr_write(new_gpr, commit & w1_cond, w1_idx, w1_val, w1_size)
+
+    # -- rflags ------------------------------------------------------------
+    rf_exec = opc_list([
+        (is_(U.OPC_ALU), alu_rf),
+        (is_(U.OPC_SHIFT), sh_rf),
+        (is_(U.OPC_UNARY), un_rf),
+        (is_mul, mul_rf),
+        (is_(U.OPC_BT), bt_rf),
+        (is_(U.OPC_BITSCAN), bs_rf),
+        (is_string, jnp.where((s_scas | s_cmps) & ~rep_skip, str_rf, rf)),
+        (is_(U.OPC_CMPXCHG), cx_rf),
+        (is_(U.OPC_XADD), xadd_rf),
+        (is_(U.OPC_RDRAND), rdrand_rf),
+        (is_(U.OPC_FLAGOP), flagop_rf),
+        (is_popf, popf_rf),
+        (is_(U.OPC_SYSCALL), jnp.where(syscall_entry, syscall_rf, sysret_rf)),
+        (is_ssealu & (sub == U.SSE_PTEST), ptest_rf),
+    ], rf)
+    new_rf = jnp.where(commit, rf_exec | _u(0x2), rf)
+
+    # -- rip ---------------------------------------------------------------
+    rip_exec = opc_list([
+        (is_(U.OPC_JMP) | is_call, jmp_target),
+        (is_(U.OPC_JCC), jnp.where(cc_true, next_rip + imm, next_rip)),
+        (is_ret, ret_target),
+        (is_(U.OPC_SYSCALL), jnp.where(syscall_entry, st.lstar, gpr[1])),
+        (is_string, jnp.where(str_done, next_rip, rip)),
+    ], next_rip)
+    new_rip = jnp.where(commit, rip_exec, rip)
+
+    # -- control registers / gs bases -------------------------------------
+    cr_w = commit & movcr_is_write
+    new_cr0 = jnp.where(cr_w & (sub == 0), cr_wval, st.cr0)
+    new_cr3 = jnp.where(cr_w & (sub == 3), cr_wval, st.cr3)
+    new_cr4 = jnp.where(cr_w & (sub == 4), cr_wval, st.cr4)
+    new_cr8 = jnp.where(cr_w & (sub == 8), cr_wval, st.cr8)
+    cr3_changed = cr_w & (sub == 3) & (cr_wval != st.cr3_base)
+    sw = commit & is_swapgs
+    new_gs = jnp.where(sw, st.kernel_gs_base, st.gs_base)
+    new_kgs = jnp.where(sw, st.gs_base, st.kernel_gs_base)
+
+    # -- xmm ---------------------------------------------------------------
+    wx_cond = commit & (
+        (is_ssemov & (sub != 2) & (dk == U.K_XMM))
+        | (is_ssealu & (sub != U.SSE_PMOVMSKB) & (sub != U.SSE_PTEST)))
+    wx_lo = jnp.where(is_ssealu, sse_out_lo, ssm_lo)
+    wx_hi = jnp.where(is_ssealu, sse_out_hi, ssm_hi)
+    xr = jnp.clip(dr, 0, 15)
+    new_xmm = xmm.at[xr].set(jnp.where(
+        wx_cond, jnp.stack([wx_lo, wx_hi]), xmm[xr]))
+
+    # -- bookkeeping -------------------------------------------------------
+    new_icount = st.icount + jnp.where(commit, _u(1), _u(0))
+    timed = commit & (limit > _u(0)) & (new_icount >= limit)
+    new_rdrand = jnp.where(commit & is_(U.OPC_RDRAND), rdrand_next, st.rdrand)
+    new_bp_skip = jnp.where(commit, jnp.int32(0), st.bp_skip)
+
+    # coverage: the instruction was reached (reference records RIP in
+    # before_execution even when the insn then faults, bochscpu:479-505)
+    cov_set = live
+    wi = idxc >> 5
+    cov_bit = jnp.where(cov_set,
+                        jnp.uint32(1) << (idxc & 31).astype(jnp.uint32),
+                        jnp.uint32(0))
+    new_cov = st.cov.at[wi].set(st.cov[wi] | cov_bit)
+
+    # edges: taken AND not-taken control transfers (reference registers
+    # cnear_branch_taken/not_taken + ucnear hooks, bochscpu:235-257)
+    is_branch = is_(U.OPC_JMP) | is_(U.OPC_JCC) | is_call | is_ret
+    eh = _mix64(rip) ^ rip_exec
+    ebits = st.edge.shape[0] * 32
+    ei = (eh & _u(ebits - 1)).astype(jnp.int32)
+    edge_bit = jnp.where(commit & is_branch,
+                         jnp.uint32(1) << (ei & 31).astype(jnp.uint32),
+                         jnp.uint32(0))
+    new_edge = st.edge.at[ei >> 5].set(st.edge[ei >> 5] | edge_bit)
+
+    # -- status ------------------------------------------------------------
+    S = StatusCode
+    status_chain = jnp.select(
+        [miss, at_bp, smc, unsupported, page_fault, de, is_crash, ovf,
+         cr3_changed, timed],
+        [jnp.int32(int(S.NEED_DECODE)), jnp.int32(int(S.BREAKPOINT)),
+         jnp.int32(int(S.SMC)), jnp.int32(int(S.UNSUPPORTED)),
+         jnp.int32(int(S.PAGE_FAULT)), jnp.int32(int(S.DIVIDE_ERROR)),
+         jnp.int32(int(S.CRASH)), jnp.int32(int(S.OVERLAY_FULL)),
+         jnp.int32(int(S.CR3_CHANGE)), jnp.int32(int(S.TIMEDOUT))],
+        default=jnp.int32(int(S.RUNNING)))
+    new_status = jnp.where(enabled, status_chain, st.status)
+
+    new_fault_gva = jnp.where(
+        enabled & page_fault,
+        jnp.where(fault1, l1_addr, jnp.where(fault2, l2_addr, st_addr)),
+        jnp.where(enabled & is_crash, rip, st.fault_gva))
+    new_fault_write = jnp.where(
+        enabled & page_fault & ~fault1 & ~fault2, jnp.int32(1),
+        jnp.where(enabled & page_fault, jnp.int32(0), st.fault_write))
+
+    return st._replace(
+        gpr=new_gpr, rip=new_rip, rflags=new_rf, xmm=new_xmm,
+        gs_base=new_gs, kernel_gs_base=new_kgs,
+        cr0=new_cr0, cr3=new_cr3, cr4=new_cr4, cr8=new_cr8,
+        status=new_status, icount=new_icount, rdrand=new_rdrand,
+        bp_skip=new_bp_skip, fault_gva=new_fault_gva,
+        fault_write=new_fault_write, cov=new_cov, edge=new_edge,
+        overlay=overlay)
+
+
+# ---------------------------------------------------------------------------
+# chunked batch run
+# ---------------------------------------------------------------------------
+
+def make_run_chunk(n_steps: int):
+    """Build the jitted chunk executor: up to n_steps vmapped transitions
+    with early exit when no lane is RUNNING.  The host runner
+    (interp/runner.py) calls this in a loop, servicing lane statuses between
+    chunks — the batched analog of the reference's vmexit servicing
+    (kvm_backend.cc:1371-1566)."""
+    step_v = jax.vmap(step_lane, in_axes=(None, None, 0, None))
+
+    @jax.jit
+    def run_chunk(tab: UopTable, image: MemImage, machine: Machine, limit):
+        def cond(carry):
+            i, m = carry
+            return (i < n_steps) & jnp.any(
+                m.status == jnp.int32(int(StatusCode.RUNNING)))
+
+        def body(carry):
+            i, m = carry
+            return i + 1, step_v(tab, image, m, limit)
+
+        _, out = lax.while_loop(cond, body, (jnp.int32(0), machine))
+        return out
+
+    return run_chunk
