@@ -33,6 +33,9 @@ class StatusCode(enum.IntEnum):
     SMC = 9           # lane's code bytes diverge from the shared decode cache
     OVERLAY_FULL = 10 # lane ran out of dirty-page overlay slots
     DIVIDE_ERROR = 11 # #DE (div by zero / quotient overflow)
+    HARD_ERROR = 12   # terminal: instruction unsupported even by the host
+                      # oracle, or other unrecoverable servicing failure
+                      # (details in Runner.lane_errors)
 
 
 # Statuses the device can set that the host run loop must service before the

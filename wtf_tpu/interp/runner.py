@@ -1,0 +1,537 @@
+"""Host orchestration of the batched device interpreter.
+
+The reference's run loop alternates guest execution with host servicing
+(vmexits: kvm_backend.cc:1371-1566; emulator hooks: bochscpu_backend.cc:
+352-548).  Here the device runs *chunks* of vmapped steps (interp/step.py)
+and the host services whatever each lane reported in its status word:
+
+  NEED_DECODE  - decode bytes at the lane's rip once, publish to the shared
+                 uop table, resume (the JIT-translation-cache fill path)
+  SMC          - lane's code bytes diverged from the cache: re-decode and
+                 update the entry in place
+  UNSUPPORTED  - single-step the lane on the host EmuCpu oracle (precise
+                 slow path; mirrors the bochscpu-backs-KVM methodology)
+  BREAKPOINT   - dispatch to the backend's registered handler
+  terminal     - OK/CRASH/TIMEDOUT/... mapped to results by the backend
+
+Host<->device traffic is batched: one pull of the small per-lane register
+arrays per service round (`HostView`), page-granular reads on demand, and
+all memory writes buffered host-side and applied in a single jitted scan
+(`_apply_page_writes`) before the next chunk.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from wtf_tpu.core.cpustate import CpuState
+from wtf_tpu.core.gxa import PAGE_SHIFT, PAGE_SIZE
+from wtf_tpu.core.results import StatusCode
+from wtf_tpu.cpu.decoder import decode
+from wtf_tpu.cpu import uops as U
+from wtf_tpu.cpu.emu import (
+    DivideError, EmuCpu, GuestCrash, MemFault, UnsupportedInsn,
+)
+from wtf_tpu.interp.machine import Machine, machine_init, machine_restore
+from wtf_tpu.interp.step import make_run_chunk
+from wtf_tpu.interp.uoptable import DecodeCache
+from wtf_tpu.snapshot.loader import Snapshot
+
+MASK64 = (1 << 64) - 1
+
+PTE_P = 1
+PTE_W = 1 << 1
+PTE_PS = 1 << 7
+PHYS_MASK = 0x000F_FFFF_FFFF_F000
+
+# Machine leaves mirrored into HostView (everything except overlay/cov/edge).
+_MIRROR_FIELDS = (
+    "gpr", "rip", "rflags", "xmm", "fs_base", "gs_base", "kernel_gs_base",
+    "cr0", "cr3", "cr4", "cr8", "lstar", "star", "sfmask", "tsc",
+    "status", "icount", "rdrand", "bp_skip", "fault_gva", "fault_write",
+)
+
+
+class HostFault(Exception):
+    """Host-side page walk failed (non-present / non-canonical)."""
+
+    def __init__(self, gva: int, write: bool):
+        super().__init__(f"host #PF {'write' if write else 'read'} @ {gva:#x}")
+        self.gva = gva
+        self.write = write
+
+
+class HostView:
+    """Mutable host mirror of the batch: registers as numpy arrays, guest
+    memory as a merged (pending-writes | device overlay | base image) view.
+
+    This is what breakpoint handlers and the target harness operate on — the
+    equivalent of the reference's `Backend_t` register/VirtRead/VirtWriteDirty
+    surface (backend.cc:30-127), but for all lanes at once.  Mutations stay
+    host-side until `Runner._push` applies them in one batch.
+    """
+
+    def __init__(self, runner: "Runner"):
+        self.runner = runner
+        m = runner.machine
+        self.r: Dict[str, np.ndarray] = {
+            name: np.array(getattr(m, name)) for name in _MIRROR_FIELDS
+        }
+        # overlay index pulled once; data rows fetched lazily per (lane, pfn)
+        self._ov_pfn = np.asarray(m.overlay.pfn)
+        self._page_cache: Dict[Tuple[int, int], bytes] = {}
+        self.pending: Dict[Tuple[int, int], bytearray] = {}
+
+    # -- registers -------------------------------------------------------
+    def get_reg(self, lane: int, idx: int) -> int:
+        return int(self.r["gpr"][lane, idx])
+
+    def set_reg(self, lane: int, idx: int, value: int) -> None:
+        self.r["gpr"][lane, idx] = np.uint64(value & MASK64)
+
+    def get_rip(self, lane: int) -> int:
+        return int(self.r["rip"][lane])
+
+    def set_rip(self, lane: int, value: int) -> None:
+        self.r["rip"][lane] = np.uint64(value & MASK64)
+
+    def set_status(self, lane: int, status: StatusCode) -> None:
+        self.r["status"][lane] = np.int32(int(status))
+
+    def get_status(self, lane: int) -> StatusCode:
+        return StatusCode(int(self.r["status"][lane]))
+
+    # -- physical memory -------------------------------------------------
+    def _base_page(self, pfn: int) -> bytes:
+        return self.runner.physmem.host_read(pfn << PAGE_SHIFT, PAGE_SIZE)
+
+    def _device_overlay_page(self, lane: int, pfn: int) -> Optional[bytes]:
+        slots = np.nonzero(self._ov_pfn[lane] == pfn)[0]
+        if len(slots) == 0:
+            return None
+        data = self.runner.machine.overlay.data[lane, int(slots[0])]
+        return bytes(np.asarray(data))
+
+    def page(self, lane: int, pfn: int) -> bytes:
+        """Current contents of a guest-physical page as this lane sees it."""
+        key = (lane, pfn)
+        if key in self.pending:
+            return bytes(self.pending[key])
+        cached = self._page_cache.get(key)
+        if cached is None:
+            cached = self._device_overlay_page(lane, pfn)
+            if cached is None:
+                cached = self._base_page(pfn)
+            self._page_cache[key] = cached
+        return cached
+
+    def page_dirty(self, lane: int, pfn: int) -> bool:
+        return ((lane, pfn) in self.pending
+                or bool(np.any(self._ov_pfn[lane] == pfn)))
+
+    def phys_read(self, lane: int, gpa: int, size: int) -> bytes:
+        out = bytearray()
+        pos = gpa
+        while pos < gpa + size:
+            pfn = pos >> PAGE_SHIFT
+            off = pos & (PAGE_SIZE - 1)
+            chunk = min(gpa + size - pos, PAGE_SIZE - off)
+            out += self.page(lane, pfn)[off:off + chunk]
+            pos += chunk
+        return bytes(out)
+
+    def phys_write(self, lane: int, gpa: int, data: bytes) -> None:
+        pos = 0
+        while pos < len(data):
+            addr = gpa + pos
+            pfn = addr >> PAGE_SHIFT
+            off = addr & (PAGE_SIZE - 1)
+            chunk = min(len(data) - pos, PAGE_SIZE - off)
+            key = (lane, pfn)
+            if key not in self.pending:
+                self.pending[key] = bytearray(self.page(lane, pfn))
+            self.pending[key][off:off + chunk] = data[pos:pos + chunk]
+            pos += chunk
+
+    # -- virtual memory --------------------------------------------------
+    def translate(self, lane: int, gva: int, write: bool = False) -> int:
+        """4-level long-mode walk through this lane's memory view
+        (reference kvm_backend.cc:1937-1998)."""
+        gva &= MASK64
+        top = gva >> 47
+        if top != 0 and top != 0x1FFFF:
+            raise HostFault(gva, write)
+        table = int(self.r["cr3"][lane]) & PHYS_MASK
+        for shift, large_mask in ((39, None), (30, 0x000F_FFFF_C000_0000),
+                                  (21, 0x000F_FFFF_FFE0_0000), (12, None)):
+            index = (gva >> shift) & 0x1FF
+            entry = int.from_bytes(
+                self.phys_read(lane, table + index * 8, 8), "little")
+            if not entry & PTE_P:
+                raise HostFault(gva, write)
+            if large_mask is not None and entry & PTE_PS:
+                return (entry & large_mask) | (gva & ((1 << shift) - 1))
+            if shift == 12:
+                return (entry & PHYS_MASK) | (gva & 0xFFF)
+            table = entry & PHYS_MASK
+        raise AssertionError("unreachable")
+
+    def virt_read(self, lane: int, gva: int, size: int) -> bytes:
+        out = bytearray()
+        pos = gva
+        while pos < gva + size:
+            off = pos & (PAGE_SIZE - 1)
+            chunk = min(gva + size - pos, PAGE_SIZE - off)
+            gpa = self.translate(lane, pos)
+            out += self.phys_read(lane, gpa, chunk)
+            pos += chunk
+        return bytes(out)
+
+    def virt_write(self, lane: int, gva: int, data: bytes) -> None:
+        """Host-initiated guest write.  Writes through page protection (the
+        reference's VirtWrite is a raw memcpy, backend.cc:91-127) and is
+        dirty by construction — it lands in the overlay and rolls back at
+        Restore, preserving the VirtWriteDirty contract."""
+        pos = 0
+        while pos < len(data):
+            addr = gva + pos
+            off = addr & (PAGE_SIZE - 1)
+            chunk = min(len(data) - pos, PAGE_SIZE - off)
+            gpa = self.translate(lane, addr, write=False)
+            self.phys_write(lane, gpa, data[pos:pos + chunk])
+            pos += chunk
+
+
+class _FallbackMem:
+    """EmuMem-compatible adapter running the EmuCpu oracle against one
+    lane's HostView (slow-path single-stepping for UNSUPPORTED uops)."""
+
+    def __init__(self, view: HostView, lane: int):
+        self.view = view
+        self.lane = lane
+
+    def phys_read(self, gpa: int, size: int) -> bytes:
+        return self.view.phys_read(self.lane, gpa, size)
+
+    def phys_write(self, gpa: int, data: bytes) -> None:
+        self.view.phys_write(self.lane, gpa, data)
+
+    def phys_read_u64(self, gpa: int) -> int:
+        return int.from_bytes(self.phys_read(gpa, 8), "little")
+
+    @property
+    def overlay(self):
+        # EmuCpu probes `pfn in mem.overlay` for its SMC check; expose the
+        # lane's dirty-page predicate as a minimal container.
+        view, lane = self.view, self.lane
+
+        class _DirtySet:
+            def __contains__(self, pfn):
+                return view.page_dirty(lane, pfn)
+
+        return _DirtySet()
+
+
+def _lane_cpu_state(view: HostView, lane: int, snapshot_cpu: CpuState) -> CpuState:
+    """Materialize a CpuState for the oracle from a lane's mirror (fields the
+    device doesn't carry — segments, dr, x87 — come from the snapshot)."""
+    cpu = snapshot_cpu.copy()
+    cpu.set_gpr_list(list(view.r["gpr"][lane]))
+    cpu.rip = int(view.r["rip"][lane])
+    cpu.rflags = int(view.r["rflags"][lane])
+    cpu.fs.base = int(view.r["fs_base"][lane])
+    cpu.gs.base = int(view.r["gs_base"][lane])
+    cpu.kernel_gs_base = int(view.r["kernel_gs_base"][lane])
+    cpu.cr0 = int(view.r["cr0"][lane])
+    cpu.cr3 = int(view.r["cr3"][lane])
+    cpu.cr4 = int(view.r["cr4"][lane])
+    cpu.cr8 = int(view.r["cr8"][lane])
+    cpu.lstar = int(view.r["lstar"][lane])
+    cpu.star = int(view.r["star"][lane])
+    cpu.sfmask = int(view.r["sfmask"][lane])
+    cpu.tsc = int(view.r["tsc"][lane])
+    for i in range(16):
+        cpu.zmm[i][0] = int(view.r["xmm"][lane, i, 0])
+        cpu.zmm[i][1] = int(view.r["xmm"][lane, i, 1])
+    return cpu
+
+
+def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
+    view.r["gpr"][lane] = np.array(cpu.gpr, dtype=np.uint64)
+    view.r["rip"][lane] = np.uint64(cpu.rip & MASK64)
+    view.r["rflags"][lane] = np.uint64(cpu.rflags & MASK64)
+    view.r["fs_base"][lane] = np.uint64(cpu.fs_base & MASK64)
+    view.r["gs_base"][lane] = np.uint64(cpu.gs_base & MASK64)
+    view.r["kernel_gs_base"][lane] = np.uint64(cpu.kernel_gs_base & MASK64)
+    view.r["cr0"][lane] = np.uint64(cpu.cr0 & MASK64)
+    view.r["cr3"][lane] = np.uint64(cpu.cr3 & MASK64)
+    view.r["cr4"][lane] = np.uint64(cpu.cr4 & MASK64)
+    view.r["cr8"][lane] = np.uint64(cpu.cr8 & MASK64)
+    for i in range(16):
+        view.r["xmm"][lane, i, 0] = np.uint64(cpu.xmm[i][0] & MASK64)
+        view.r["xmm"][lane, i, 1] = np.uint64(cpu.xmm[i][1] & MASK64)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _apply_page_writes(machine: Machine, lanes, pfns, pages, valid):
+    """Apply K buffered (lane, pfn, page) writes into the batched overlay in
+    one device call (lax.scan; K is padded to a bucket size host-side)."""
+    capacity = machine.overlay.pfn.shape[1]
+
+    def body(overlay, item):
+        lane, pfn, page, ok = item
+        row = overlay.pfn[lane]
+        eq = row == pfn
+        idx0 = jnp.argmax(eq).astype(jnp.int32)
+        hit = eq[idx0]
+        can = overlay.count[lane] < capacity
+        slot = jnp.where(hit, idx0, overlay.count[lane] % capacity)
+        do = ok & (hit | can)
+        data = overlay.data.at[lane, slot].set(
+            jnp.where(do, page, overlay.data[lane, slot]))
+        pfn_new = overlay.pfn.at[lane, slot].set(
+            jnp.where(do, pfn, overlay.pfn[lane, slot]).astype(jnp.int32))
+        count = overlay.count.at[lane].add(
+            jnp.where(ok & ~hit & can, 1, 0).astype(jnp.int32))
+        overflow = overlay.overflow.at[lane].set(
+            overlay.overflow[lane] | (ok & ~hit & ~can))
+        return overlay._replace(pfn=pfn_new, data=data, count=count,
+                                overflow=overflow), None
+
+    overlay, _ = lax.scan(body, machine.overlay, (lanes, pfns, pages, valid))
+    return machine._replace(overlay=overlay)
+
+
+class Runner:
+    """Owns the device batch + decode cache and drives the chunked run loop.
+
+    One Runner == one snapshot loaded on device == N lanes of that snapshot
+    (the reference equivalent is one Backend_t instance == one VM; here the
+    VM is the whole batch)."""
+
+    def __init__(
+        self,
+        snapshot: Snapshot,
+        n_lanes: int,
+        uop_capacity: int = 1 << 14,
+        overlay_slots: int = 128,
+        edge_bits: int = 17,
+        chunk_steps: int = 256,
+    ):
+        self.snapshot = snapshot
+        self.physmem = snapshot.physmem
+        self.cpu0 = snapshot.cpu
+        self.n_lanes = n_lanes
+        self.cache = DecodeCache(capacity=uop_capacity)
+        self.machine = machine_init(
+            snapshot.cpu, n_lanes, uop_capacity, overlay_slots, edge_bits)
+        self.template = machine_init(
+            snapshot.cpu, n_lanes, uop_capacity, overlay_slots=0,
+            edge_bits=edge_bits)
+        self.limit = 0
+        self.chunk_steps = chunk_steps
+        self._run_chunk = make_run_chunk(chunk_steps)
+        self.lane_errors: Dict[int, str] = {}
+        self._smc_updates: Dict[int, int] = {}
+        # run statistics (reference PrintRunStats role, backend.h:218)
+        self.stats = {
+            "chunks": 0, "decodes": 0, "fallbacks": 0, "smc_updates": 0,
+            "bp_dispatches": 0,
+        }
+
+    # -- host memory access ------------------------------------------------
+    def view(self) -> HostView:
+        return HostView(self)
+
+    def push(self, view: HostView) -> None:
+        """Apply a HostView's mutations (registers + buffered page writes)
+        back to the device batch."""
+        updates = {
+            name: jnp.asarray(view.r[name]) for name in _MIRROR_FIELDS
+        }
+        self.machine = self.machine._replace(**updates)
+        if view.pending:
+            items = sorted(view.pending.items())
+            k = len(items)
+            bucket = 8
+            while bucket < k:
+                bucket *= 2
+            lanes = np.zeros(bucket, dtype=np.int32)
+            pfns = np.full(bucket, -2, dtype=np.int32)
+            pages = np.zeros((bucket, PAGE_SIZE), dtype=np.uint8)
+            valid = np.zeros(bucket, dtype=bool)
+            for j, ((lane, pfn), page) in enumerate(items):
+                lanes[j] = lane
+                pfns[j] = pfn
+                pages[j] = np.frombuffer(bytes(page), dtype=np.uint8)
+                valid[j] = True
+            self.machine = _apply_page_writes(
+                self.machine, jnp.asarray(lanes), jnp.asarray(pfns),
+                jnp.asarray(pages), jnp.asarray(valid))
+            view.pending.clear()
+
+    # -- servicing ---------------------------------------------------------
+    def _decode_at(self, view: HostView, lane: int, rip: int) -> bool:
+        """Decode the instruction at `rip` through `lane`'s memory view and
+        publish it.  Returns False on hard failure (lane made terminal)."""
+        try:
+            window = view.virt_read(lane, rip, 15)
+            pfn0 = view.translate(lane, rip) >> PAGE_SHIFT
+        except HostFault:
+            self.lane_errors[lane] = f"fetch fault @ {rip:#x}"
+            view.set_status(lane, StatusCode.PAGE_FAULT)
+            return False
+        uop = decode(window, rip)
+        try:
+            pfn1 = view.translate(lane, rip + max(uop.length - 1, 0)) >> PAGE_SHIFT
+        except HostFault:
+            pfn1 = pfn0
+        self.cache.add(rip, uop, pfn0, pfn1)
+        self.stats["decodes"] += 1
+        return True
+
+    def _service_decode(self, view: HostView, lanes: List[int]) -> None:
+        done: Set[int] = set()
+        for lane in lanes:
+            rip = view.get_rip(lane)
+            if rip not in done:
+                if rip not in self.cache.index:
+                    if not self._decode_at(view, lane, rip):
+                        continue
+                done.add(rip)
+            view.set_status(lane, StatusCode.RUNNING)
+
+    def _service_smc(self, view: HostView, lanes: List[int]) -> None:
+        for lane in lanes:
+            rip = view.get_rip(lane)
+            n = self._smc_updates.get(rip, 0) + 1
+            self._smc_updates[rip] = n
+            if n > 16:
+                # cache thrash: lanes disagree about the bytes at this rip;
+                # fall back to the oracle for this lane instead of ping-
+                # ponging the shared entry (documented batch-vs-VM tradeoff)
+                self._fallback_step(view, lane)
+                continue
+            try:
+                window = view.virt_read(lane, rip, 15)
+                pfn0 = view.translate(lane, rip) >> PAGE_SHIFT
+            except HostFault:
+                view.set_status(lane, StatusCode.PAGE_FAULT)
+                continue
+            uop = decode(window, rip)
+            try:
+                pfn1 = view.translate(lane, rip + max(uop.length - 1, 0)) >> PAGE_SHIFT
+            except HostFault:
+                pfn1 = pfn0
+            self.cache.update(rip, uop, pfn0, pfn1)
+            self.stats["smc_updates"] += 1
+            view.set_status(lane, StatusCode.RUNNING)
+
+    def _fallback_step(self, view: HostView, lane: int) -> None:
+        """Single-step one lane on the EmuCpu oracle (the host slow path for
+        instructions outside the device subset)."""
+        self.stats["fallbacks"] += 1
+        cpu_state = _lane_cpu_state(view, lane, self.cpu0)
+        emu = EmuCpu(_FallbackMem(view, lane), cpu_state)
+        emu.icount = int(view.r["icount"][lane])
+        emu.rdrand_state = int(view.r["rdrand"][lane])
+        try:
+            emu.step()
+        except GuestCrash:
+            view.set_status(lane, StatusCode.CRASH)
+            view.r["fault_gva"][lane] = np.uint64(emu.rip & MASK64)
+            return
+        except MemFault as e:
+            view.set_status(lane, StatusCode.PAGE_FAULT)
+            view.r["fault_gva"][lane] = np.uint64(e.gva & MASK64)
+            view.r["fault_write"][lane] = np.int32(1 if e.write else 0)
+            return
+        except DivideError:
+            view.set_status(lane, StatusCode.DIVIDE_ERROR)
+            return
+        except UnsupportedInsn as e:
+            self.lane_errors[lane] = str(e)
+            view.set_status(lane, StatusCode.HARD_ERROR)
+            return
+        _writeback_lane(view, lane, emu)
+        view.r["icount"][lane] = np.uint64(emu.icount)
+        view.r["rdrand"][lane] = np.uint64(emu.rdrand_state)
+        view.r["bp_skip"][lane] = np.int32(0)
+        if emu.cr3_event is not None and emu.cr3_event != self.cpu0.cr3:
+            view.set_status(lane, StatusCode.CR3_CHANGE)
+        elif self.limit and emu.icount >= self.limit:
+            view.set_status(lane, StatusCode.TIMEDOUT)
+        else:
+            view.set_status(lane, StatusCode.RUNNING)
+
+    # -- run loop ----------------------------------------------------------
+    def run(
+        self,
+        bp_handler: Optional[Callable[["Runner", HostView, int], None]] = None,
+        max_chunks: int = 1 << 20,
+    ) -> np.ndarray:
+        """Drive the batch until every lane reaches a terminal status.
+
+        `bp_handler(runner, view, lane)` services BREAKPOINT lanes (the
+        backend layer supplies it; reference breakpoint dispatch is
+        backend.h:231 + kvm_backend.cc:1256-1369).  Returns the final status
+        array."""
+        tab = self.cache.device()
+        limit = jnp.uint64(self.limit)
+        for _ in range(max_chunks):
+            self.machine = self._run_chunk(
+                tab, self.physmem.image, self.machine, limit)
+            self.stats["chunks"] += 1
+            status = np.asarray(self.machine.status)
+            running = status == int(StatusCode.RUNNING)
+            need = {
+                int(StatusCode.NEED_DECODE): [],
+                int(StatusCode.SMC): [],
+                int(StatusCode.UNSUPPORTED): [],
+                int(StatusCode.BREAKPOINT): [],
+            }
+            for lane in np.nonzero(np.isin(status, list(need)))[0]:
+                need[int(status[lane])].append(int(lane))
+            total = sum(len(v) for v in need.values())
+            if total == 0:
+                if not running.any():
+                    return status
+                continue
+
+            view = self.view()
+            if need[int(StatusCode.NEED_DECODE)]:
+                self._service_decode(view, need[int(StatusCode.NEED_DECODE)])
+            if need[int(StatusCode.SMC)]:
+                self._service_smc(view, need[int(StatusCode.SMC)])
+            for lane in need[int(StatusCode.UNSUPPORTED)]:
+                self._fallback_step(view, lane)
+            for lane in need[int(StatusCode.BREAKPOINT)]:
+                self.stats["bp_dispatches"] += 1
+                if bp_handler is None:
+                    self.lane_errors[lane] = (
+                        f"breakpoint @ {view.get_rip(lane):#x} with no handler")
+                    view.set_status(lane, StatusCode.CRASH)
+                    continue
+                bp_handler(self, view, lane)
+                if view.get_status(lane) == StatusCode.BREAKPOINT:
+                    view.r["bp_skip"][lane] = np.int32(1)
+                    view.set_status(lane, StatusCode.RUNNING)
+            self.push(view)
+            tab = self.cache.device()
+        raise RuntimeError("run loop exceeded max_chunks")
+
+    def restore(self) -> None:
+        """Every lane back to the snapshot: O(1) overlay reset + register
+        broadcast (replaces the reference's dirty-page rewrite loops,
+        SURVEY.md §5.4)."""
+        self.machine = machine_restore(self.machine, self.template)
+        self.lane_errors.clear()
+
+    def statuses(self) -> np.ndarray:
+        return np.asarray(self.machine.status)
