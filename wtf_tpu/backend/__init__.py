@@ -1,0 +1,29 @@
+"""Execution backends: the pluggable layer the whole system runs on.
+
+Mirror of the reference's `Backend_t` plugin architecture (reference
+src/wtf/backend.h:161-596, SURVEY.md §2.2): everything above this layer
+(targets, crash detection, fuzz loop, CLI) talks only to the `Backend`
+contract, so backends are interchangeable:
+
+  base.py - the contract + derived conveniences (GetArg, SimulateReturn...)
+  emu.py  - EmuBackend over the pure-Python oracle CPU: the deterministic
+            reference backend (role of bochscpu) and the TPU-less test seam
+  tpu.py  - TpuBackend over the batched device interpreter: N testcase
+            lanes per Run, the reason this framework exists
+
+Selected by name like the reference's --backend flag (wtf.cc:208-225).
+"""
+
+from wtf_tpu.backend.base import Backend, BreakpointHandler  # noqa: F401
+from wtf_tpu.backend.emu import EmuBackend  # noqa: F401
+from wtf_tpu.backend.tpu import TpuBackend  # noqa: F401
+
+
+def create_backend(name: str, snapshot, **kwargs) -> Backend:
+    """Instantiate a backend by CLI name (reference wtf.cc:403-415)."""
+    if name == "emu":
+        kwargs.pop("n_lanes", None)
+        return EmuBackend(snapshot, **kwargs)
+    if name == "tpu":
+        return TpuBackend(snapshot, **kwargs)
+    raise ValueError(f"unknown backend {name!r} (expected emu|tpu)")

@@ -1,0 +1,161 @@
+"""EmuBackend: the oracle CPU behind the Backend contract.
+
+Plays the role bochscpu plays in the reference (slowest, fully
+deterministic, precise — README.md:7) *and* the fake-backend test seam
+SURVEY.md §4 calls for: the whole harness/fuzz/distribution plane runs on
+it without a TPU in sight.  One guest, one lane.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Optional, Set
+
+from wtf_tpu.backend.base import Backend, BreakpointHandler
+from wtf_tpu.core.results import (
+    Cr3Change, Crash, Ok, TestcaseResult, Timedout,
+)
+from wtf_tpu.cpu.emu import (
+    DivideError, EmuCpu, EmuMem, GuestCrash, MemFault, UnsupportedInsn,
+)
+from wtf_tpu.snapshot.loader import Snapshot
+from wtf_tpu.utils.hashing import splitmix64
+
+
+class EmuBackend(Backend):
+    def __init__(self, snapshot: Snapshot, limit: int = 0):
+        self.snapshot = snapshot
+        self.symbols = snapshot.symbols
+        self.limit = limit
+        self.breakpoints: Dict[int, BreakpointHandler] = {}
+        self.cpu: Optional[EmuCpu] = None
+        self._stop_result: Optional[TestcaseResult] = None
+        self._run_cov: Set[int] = set()
+        self._aggregate_cov: Set[int] = set()
+        self._last_new: Set[int] = set()
+        self._trace_file = None
+        self._trace_type = None
+        self.stats = {"runs": 0, "instructions": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self) -> None:
+        self.cpu = EmuCpu(EmuMem(self.snapshot.physmem), self.snapshot.cpu)
+
+    def run(self) -> TestcaseResult:
+        assert self.cpu is not None, "initialize() first"
+        cpu = self.cpu
+        self._stop_result = None
+        self._run_cov = set()
+        skip_rip = None  # one-shot bp suppression after handler resume
+        result: TestcaseResult
+        trace = None
+        if self._trace_file is not None:
+            trace = open(self._trace_file, "w")
+        try:
+            while True:
+                if self.limit and cpu.icount >= self.limit:
+                    result = Timedout()
+                    break
+                rip = cpu.rip
+                if rip in self.breakpoints and rip != skip_rip:
+                    skip_rip = rip
+                    self.breakpoints[rip](self)
+                    if self._stop_result is not None:
+                        result = self._stop_result
+                        break
+                    if cpu.rip != rip:
+                        skip_rip = None
+                    continue
+                skip_rip = None
+                if rip not in self._run_cov:
+                    self._run_cov.add(rip)
+                    if trace is not None and self._trace_type == "cov":
+                        trace.write(f"{rip:#x}\n")
+                if trace is not None and self._trace_type == "rip":
+                    trace.write(f"{rip:#x}\n")
+                try:
+                    cpu.step()
+                except GuestCrash as e:
+                    result = Crash(f"crash-int-{e.rip:#x}")
+                    break
+                except MemFault as e:
+                    kind = "write" if e.write else "read"
+                    result = Crash(f"crash-{kind}-{e.gva:#x}")
+                    break
+                except DivideError:
+                    result = Crash(f"crash-de-{rip:#x}")
+                    break
+                except UnsupportedInsn as e:
+                    result = Crash(f"crash-unsupported-{e.rip:#x}")
+                    break
+                if cpu.cr3_event is not None:
+                    if cpu.cr3_event != self.snapshot.cpu.cr3:
+                        result = Cr3Change()
+                        break
+                    cpu.cr3_event = None
+        finally:
+            if trace is not None:
+                trace.close()
+            self._trace_file = None
+        self.stats["runs"] += 1
+        self.stats["instructions"] += cpu.icount
+        # coverage merge (reference: per-run set union into the aggregate,
+        # LastNewCoverage = the delta, bochscpu_backend.cc:497-505)
+        self._last_new = self._run_cov - self._aggregate_cov
+        self._aggregate_cov |= self._last_new
+        return result
+
+    def restore(self) -> None:
+        self.cpu.restore()
+
+    def stop(self, result: TestcaseResult) -> None:
+        self._stop_result = result
+
+    # -- registers ---------------------------------------------------------
+    def get_reg(self, idx: int) -> int:
+        return self.cpu.gpr[idx]
+
+    def set_reg(self, idx: int, value: int) -> None:
+        self.cpu.gpr[idx] = value & (1 << 64) - 1
+
+    def get_rip(self) -> int:
+        return self.cpu.rip
+
+    def set_rip(self, value: int) -> None:
+        self.cpu.rip = value & (1 << 64) - 1
+
+    # -- memory ------------------------------------------------------------
+    def virt_read(self, gva: int, size: int) -> bytes:
+        return self.cpu.virt_read(gva, size)
+
+    def virt_write(self, gva: int, data: bytes) -> None:
+        self.cpu.virt_write(gva, data, enforce=False)
+
+    # -- breakpoints -------------------------------------------------------
+    def set_breakpoint(self, gva: int, handler: BreakpointHandler) -> None:
+        self.breakpoints[gva] = handler
+
+    # -- coverage ----------------------------------------------------------
+    def last_new_coverage(self) -> Set[int]:
+        return set(self._last_new)
+
+    def revoke_last_new_coverage(self) -> None:
+        # reference client revokes after a timeout so flaky paths don't
+        # enter the corpus (client.cc:122-125)
+        self._aggregate_cov -= self._last_new
+        self._last_new = set()
+
+    # -- misc ---------------------------------------------------------------
+    def rdrand(self) -> int:
+        self.cpu.rdrand_state = splitmix64(self.cpu.rdrand_state)
+        return self.cpu.rdrand_state
+
+    def set_trace_file(self, path, trace_type: str) -> None:
+        if trace_type not in ("rip", "cov"):
+            raise ValueError(f"unsupported trace type {trace_type!r}")
+        self._trace_file = Path(path)
+        self._trace_type = trace_type
+
+    def print_run_stats(self) -> None:
+        print(f"[emu] runs={self.stats['runs']} "
+              f"instructions={self.stats['instructions']}")

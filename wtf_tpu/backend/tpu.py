@@ -1,0 +1,309 @@
+"""TpuBackend: the batched device interpreter behind the Backend contract.
+
+Where every reference backend runs ONE testcase per `Run()` inside one
+VM/emulator, this backend runs a whole *batch* — one testcase per device
+lane — per `run_batch()`.  The single-testcase `run()` facade (lane 0) keeps
+the reference's `Backend_t` calling convention for the run/trace subcommands
+and for harness code that doesn't care about batching.
+
+Lane binding: register/memory accessors operate on the backend's *current*
+lane.  During `run_batch` insertion and breakpoint dispatch the backend is
+bound to the lane being serviced, so unmodified target modules
+(`insert_testcase(backend, data)`, `handler(backend)`) work per-lane exactly
+like the reference's globals-based harness code (fuzzer_hevd.cc:20-59).
+
+Coverage: per-lane device bitmaps OR-merged into device-resident aggregate
+bitmaps after each batch; a lane "found new coverage" iff its bitmap has a
+bit outside the aggregate (reference semantics: set-union merge on the
+master, server.h:816-854).  Timeout lanes are excluded from the merge — the
+reference client revokes their coverage before reporting (client.cc:122-125).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wtf_tpu.backend.base import Backend, BreakpointHandler
+from wtf_tpu.core.results import (
+    Cr3Change, Crash, Ok, TestcaseResult, Timedout,
+)
+from wtf_tpu.core.results import StatusCode
+from wtf_tpu.interp.runner import HostView, Runner
+from wtf_tpu.snapshot.loader import Snapshot
+from wtf_tpu.utils.hashing import splitmix64
+
+MASK64 = (1 << 64) - 1
+
+_STATUS_TERMINAL_MAP = {
+    StatusCode.OK: lambda self, lane: Ok(),
+    StatusCode.TIMEDOUT: lambda self, lane: Timedout(),
+    StatusCode.CR3_CHANGE: lambda self, lane: Cr3Change(),
+}
+
+
+def _or_reduce(x):
+    return jax.lax.reduce(x, np.uint32(0), jax.lax.bitwise_or, (0,))
+
+
+@jax.jit
+def _merge_coverage(agg_cov, agg_edge, cov, edge, include):
+    """OR lane bitmaps (where `include`) into the aggregates; per-lane
+    new-coverage flags computed against the pre-merge aggregate."""
+    new_lane = (jnp.any((cov & ~agg_cov[None, :]) != 0, axis=1)
+                | jnp.any((edge & ~agg_edge[None, :]) != 0, axis=1))
+    inc = include[:, None]
+    cov_in = jnp.where(inc, cov, 0)
+    edge_in = jnp.where(inc, edge, 0)
+    cov_union = _or_reduce(cov_in)
+    edge_union = _or_reduce(edge_in)
+    new_cov_words = cov_union & ~agg_cov
+    return (agg_cov | cov_union, agg_edge | edge_union,
+            new_lane & include, new_cov_words)
+
+
+class TpuBackend(Backend):
+    def __init__(self, snapshot: Snapshot, n_lanes: int = 64,
+                 limit: int = 0, **runner_kwargs):
+        self.snapshot = snapshot
+        self.symbols = snapshot.symbols
+        self.n_lanes = n_lanes
+        self.limit = limit
+        self._runner_kwargs = runner_kwargs
+        self.runner: Optional[Runner] = None
+        self.breakpoints: Dict[int, BreakpointHandler] = {}
+        self._view: Optional[HostView] = None
+        self._lane = 0
+        self._lane_results: Dict[int, TestcaseResult] = {}
+        self._agg_cov = None
+        self._agg_edge = None
+        self._last_new_words: Optional[np.ndarray] = None
+        self._trace_request = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self) -> None:
+        self.runner = Runner(self.snapshot, self.n_lanes,
+                             **self._runner_kwargs)
+        m = self.runner.machine
+        self._agg_cov = jnp.zeros_like(m.cov[0])
+        self._agg_edge = jnp.zeros_like(m.edge[0])
+
+    # -- lane binding ------------------------------------------------------
+    @contextmanager
+    def _bound(self, view: HostView, lane: int):
+        old = (self._view, self._lane)
+        self._view, self._lane = view, lane
+        try:
+            yield
+        finally:
+            self._view, self._lane = old
+
+    def _ensure_view(self) -> HostView:
+        if self._view is None:
+            self._view = self.runner.view()
+        return self._view
+
+    # -- batch execution ---------------------------------------------------
+    def run_batch(
+        self,
+        insert: Optional[Sequence] = None,
+        target=None,
+    ) -> List[TestcaseResult]:
+        """Run one batch.  `insert` is a list of testcase buffers (one per
+        lane; shorter lists leave trailing lanes idle); `target` supplies
+        insert_testcase(backend, data).  Statuses -> TestcaseResults."""
+        runner = self.runner
+        runner.limit = self.limit
+        self._lane_results = {}
+        view = self._ensure_view()
+        n_active = self.n_lanes
+        if insert is not None:
+            n_active = len(insert)
+            for lane, data in enumerate(insert):
+                with self._bound(view, lane):
+                    target.insert_testcase(self, data)
+            for lane in range(n_active, self.n_lanes):
+                view.set_status(lane, StatusCode.OK)  # idle lanes
+        runner.push(view)
+        self._view = None
+        statuses = runner.run(bp_handler=self._dispatch_bp)
+
+        # coverage merge on device (timeouts excluded; see module docstring)
+        m = runner.machine
+        include = jnp.asarray(
+            (statuses != int(StatusCode.TIMEDOUT))
+            & (np.arange(self.n_lanes) < n_active))
+        self._agg_cov, self._agg_edge, new_lane, new_words = _merge_coverage(
+            self._agg_cov, self._agg_edge, m.cov, m.edge, include)
+        self._new_lane = np.asarray(new_lane)
+        self._last_new_words = np.asarray(new_words)
+
+        return [self._map_result(lane, statuses[lane])
+                for lane in range(n_active)]
+
+    def lane_found_new_coverage(self, lane: int) -> bool:
+        return bool(self._new_lane[lane])
+
+    def lane_result_detail(self, lane: int) -> str:
+        return self.runner.lane_errors.get(lane, "")
+
+    def _dispatch_bp(self, runner: Runner, view: HostView, lane: int) -> None:
+        rip = view.get_rip(lane)
+        handler = self.breakpoints.get(rip)
+        if handler is None:
+            runner.lane_errors[lane] = f"unexpected breakpoint @ {rip:#x}"
+            view.set_status(lane, StatusCode.HARD_ERROR)
+            return
+        with self._bound(view, lane):
+            handler(self)
+            if lane in self._lane_results:
+                # handler called stop(): park the lane terminally
+                result = self._lane_results[lane]
+                view.set_status(lane, _result_status(result))
+
+    def _map_result(self, lane: int, status_val: int) -> TestcaseResult:
+        if lane in self._lane_results:
+            return self._lane_results[lane]
+        status = StatusCode(int(status_val))
+        if status in _STATUS_TERMINAL_MAP:
+            return _STATUS_TERMINAL_MAP[status](self, lane)
+        gva = int(np.asarray(self.runner.machine.fault_gva)[lane])
+        if status == StatusCode.CRASH:
+            return Crash(f"crash-int-{gva:#x}")
+        if status == StatusCode.PAGE_FAULT:
+            write = int(np.asarray(self.runner.machine.fault_write)[lane])
+            kind = "write" if write else "read"
+            return Crash(f"crash-{kind}-{gva:#x}")
+        if status == StatusCode.DIVIDE_ERROR:
+            rip = int(np.asarray(self.runner.machine.rip)[lane])
+            return Crash(f"crash-de-{rip:#x}")
+        if status == StatusCode.OVERLAY_FULL:
+            return Crash("crash-overlay-full")
+        if status == StatusCode.HARD_ERROR:
+            detail = self.runner.lane_errors.get(lane, "hard-error")
+            return Crash(f"crash-{detail.split()[0]}")
+        raise AssertionError(f"unmapped terminal status {status!r}")
+
+    # -- Backend facade (single testcase == lane 0) ------------------------
+    def run(self) -> TestcaseResult:
+        if self._trace_request is not None:
+            return self._run_traced()
+        view = self._ensure_view()
+        for lane in range(1, self.n_lanes):
+            view.set_status(lane, StatusCode.OK)
+        self.runner.limit = self.limit
+        self._lane_results = {}
+        runner = self.runner
+        runner.push(view)
+        self._view = None
+        statuses = runner.run(bp_handler=self._dispatch_bp)
+        m = runner.machine
+        include = jnp.asarray(
+            (statuses != int(StatusCode.TIMEDOUT))
+            & (np.arange(self.n_lanes) == 0))
+        self._agg_cov, self._agg_edge, new_lane, new_words = _merge_coverage(
+            self._agg_cov, self._agg_edge, m.cov, m.edge, include)
+        self._new_lane = np.asarray(new_lane)
+        self._last_new_words = np.asarray(new_words)
+        return self._map_result(0, statuses[0])
+
+    def _run_traced(self) -> TestcaseResult:
+        """rip/cov trace runs go through the oracle for exact per-step
+        ordering (the reference's rip traces are bochscpu-only the same way,
+        wtf.cc:180-185); device state is untouched."""
+        from wtf_tpu.backend.emu import EmuBackend
+
+        path, trace_type = self._trace_request
+        self._trace_request = None
+        emu = EmuBackend(self.snapshot, limit=self.limit)
+        emu.initialize()
+        emu.breakpoints = dict(self.breakpoints)
+        # replay lane-0 pending state (testcase insertion) onto the oracle
+        view = self._ensure_view()
+        for (lane, pfn), page in sorted(view.pending.items()):
+            if lane == 0:
+                emu.cpu.mem.phys_write(pfn << 12, bytes(page))
+        emu.cpu.gpr = [int(v) for v in view.r["gpr"][0]]
+        emu.cpu.rip = int(view.r["rip"][0])
+        emu.cpu.rflags = int(view.r["rflags"][0])
+        self._view = None
+        emu.set_trace_file(path, trace_type)
+        return emu.run()
+
+    def restore(self) -> None:
+        self._view = None
+        self.runner.restore()
+
+    def stop(self, result: TestcaseResult) -> None:
+        self._lane_results[self._lane] = result
+
+    # -- registers / memory (current lane) ---------------------------------
+    def get_reg(self, idx: int) -> int:
+        return self._ensure_view().get_reg(self._lane, idx)
+
+    def set_reg(self, idx: int, value: int) -> None:
+        self._ensure_view().set_reg(self._lane, idx, value)
+
+    def get_rip(self) -> int:
+        return self._ensure_view().get_rip(self._lane)
+
+    def set_rip(self, value: int) -> None:
+        self._ensure_view().set_rip(self._lane, value)
+
+    def virt_read(self, gva: int, size: int) -> bytes:
+        return self._ensure_view().virt_read(self._lane, gva, size)
+
+    def virt_write(self, gva: int, data: bytes) -> None:
+        self._ensure_view().virt_write(self._lane, gva, data)
+
+    # -- breakpoints -------------------------------------------------------
+    def set_breakpoint(self, gva: int, handler: BreakpointHandler) -> None:
+        self.breakpoints[gva] = handler
+        self.runner.cache.set_breakpoint(gva)
+
+    # -- coverage ----------------------------------------------------------
+    def last_new_coverage(self) -> Set[int]:
+        if self._last_new_words is None:
+            return set()
+        return set(self.runner.cache.rips_of_bits(self._last_new_words))
+
+    def revoke_last_new_coverage(self) -> None:
+        if self._last_new_words is not None:
+            self._agg_cov = self._agg_cov & ~jnp.asarray(self._last_new_words)
+            self._last_new_words = None
+
+    # -- misc ---------------------------------------------------------------
+    def rdrand(self) -> int:
+        view = self._ensure_view()
+        nxt = splitmix64(int(view.r["rdrand"][self._lane]))
+        view.r["rdrand"][self._lane] = np.uint64(nxt)
+        return nxt
+
+    def set_trace_file(self, path, trace_type: str) -> None:
+        if trace_type == "cov":
+            self._trace_request = (path, "cov")
+        elif trace_type == "rip":
+            self._trace_request = (path, "rip")
+        else:
+            raise ValueError(f"unsupported trace type {trace_type!r}")
+
+    def print_run_stats(self) -> None:
+        s = self.runner.stats
+        print(f"[tpu] lanes={self.n_lanes} chunks={s['chunks']} "
+              f"decodes={s['decodes']} fallbacks={s['fallbacks']} "
+              f"bp_dispatches={s['bp_dispatches']}")
+
+
+def _result_status(result: TestcaseResult) -> StatusCode:
+    if isinstance(result, Ok):
+        return StatusCode.OK
+    if isinstance(result, Timedout):
+        return StatusCode.TIMEDOUT
+    if isinstance(result, Cr3Change):
+        return StatusCode.CR3_CHANGE
+    return StatusCode.CRASH

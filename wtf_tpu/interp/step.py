@@ -601,8 +601,9 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         default=of_keep)
     sh_full = _mkflags(sh_cf, _parity_even(sh_r), jnp.bool_(False),
                        sh_r == _u(0), sh_msb, sh_of)
-    # rcl/rcr update only CF|OF; others CF|OF|ZF|SF|PF (AF undefined->0)
-    sh_mask = jnp.where(is_rc, _u(_CF | _OF), _u(FLAGS_ARITH))
+    # rcl/rcr update only CF|OF; others CF|OF|ZF|SF|PF (AF untouched,
+    # mirroring the oracle's partial set_flags in emu._exec_shift)
+    sh_mask = jnp.where(is_rc, _u(_CF | _OF), _u(_CF | _OF | _ZF | _SF | _PF))
     sh_rf = jnp.where(cnz, (rf & ~sh_mask) | (sh_full & sh_mask), rf)
     sh_writes = cnz
 
@@ -1210,12 +1211,23 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 # chunked batch run
 # ---------------------------------------------------------------------------
 
+_CHUNK_CACHE: dict = {}
+
+
 def make_run_chunk(n_steps: int):
-    """Build the jitted chunk executor: up to n_steps vmapped transitions
-    with early exit when no lane is RUNNING.  The host runner
+    """Build (or fetch) the jitted chunk executor: up to n_steps vmapped
+    transitions with early exit when no lane is RUNNING.  The host runner
     (interp/runner.py) calls this in a loop, servicing lane statuses between
     chunks — the batched analog of the reference's vmexit servicing
-    (kvm_backend.cc:1371-1566)."""
+    (kvm_backend.cc:1371-1566).
+
+    Memoized per n_steps so every Runner with the same chunk size shares one
+    jit cache entry (XLA recompiles only on new array *shapes*, not per
+    Runner instance)."""
+    cached = _CHUNK_CACHE.get(n_steps)
+    if cached is not None:
+        return cached
+
     step_v = jax.vmap(step_lane, in_axes=(None, None, 0, None))
 
     @jax.jit
@@ -1232,4 +1244,5 @@ def make_run_chunk(n_steps: int):
         _, out = lax.while_loop(cond, body, (jnp.int32(0), machine))
         return out
 
+    _CHUNK_CACHE[n_steps] = run_chunk
     return run_chunk

@@ -25,6 +25,13 @@ import numpy as np
 from wtf_tpu.core.gxa import PAGE_SHIFT, PAGE_SIZE
 
 
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 class MemImage(NamedTuple):
     """Device half of PhysMem; broadcast (unmapped) under vmap over lanes."""
 
@@ -52,10 +59,12 @@ class PhysMem:
             max_pfn = max(pages)
         else:
             max_pfn = 0
-        nframes = max(max_pfn + 1, min_frames)
+        # Pad both array dims to powers of two: guests of similar size then
+        # share XLA-compiled executables (shape-polymorphism by padding).
+        nframes = _next_pow2(max(max_pfn + 1, min_frames))
 
         pfns = sorted(pages)
-        packed = np.zeros((len(pfns) + 1, PAGE_SIZE), dtype=np.uint8)
+        packed = np.zeros((_next_pow2(len(pfns) + 1), PAGE_SIZE), dtype=np.uint8)
         frame_table = np.zeros(nframes, dtype=np.int32)
         present = np.zeros(nframes, dtype=bool)
         for slot, pfn in enumerate(pfns, start=1):
