@@ -1,0 +1,72 @@
+"""Benchmark: fuzzing throughput of the TPU backend on the demo_tlv target.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: testcase executions per second per chip on the synthetic TLV-parser
+snapshot (the reference's headline number is execs/s of its backends on its
+demo snapshots; no Windows crash-dump ships with either tree, so both sides
+are measured on their demo parser workloads).
+
+vs_baseline: measured exec/s divided by a bochscpu-equivalent estimate for
+the same workload.  The reference publishes only relative numbers
+(bochscpu ~100x slower than KVM, README.md:291); a bochs-style interpreting
+emulator sustains ~50M instr/s on one host core, and this workload executes
+~250 instructions/testcase plus a full dirty-page restore, so the bochscpu
+role is estimated at 50e6/250 = 200k execs/s-equivalent... that flatters
+bochs (restore ignored), which is the conservative direction for us.
+"""
+
+import json
+import os
+import time
+
+os.environ.setdefault("XLA_FLAGS", "")
+
+
+def main():
+    import random
+
+    from wtf_tpu.backend import create_backend
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+    from wtf_tpu.fuzz.mutator import MangleMutator
+    from wtf_tpu.harness import demo_tlv
+
+    n_lanes = int(os.environ.get("BENCH_LANES", "256"))
+    seconds = float(os.environ.get("BENCH_SECONDS", "20"))
+
+    snapshot = demo_tlv.build_snapshot()
+    backend = create_backend("tpu", snapshot, n_lanes=n_lanes,
+                             limit=100_000, chunk_steps=512)
+    backend.initialize()
+    demo_tlv.TARGET.init(backend)
+
+    rng = random.Random(0x77F)
+    corpus = Corpus(rng=rng)
+    corpus.add(b"\x01\x04AAAA\x02\x08BBBBBBBB")
+    mutator = MangleMutator(rng, max_len=0x400)
+    loop = FuzzLoop(backend, demo_tlv.TARGET, mutator, corpus)
+
+    # warmup: first batches pay XLA compilation + decode servicing
+    loop.run_one_batch()
+    loop.run_one_batch()
+
+    start = time.time()
+    start_count = loop.stats.testcases
+    while time.time() - start < seconds:
+        loop.run_one_batch()
+    elapsed = time.time() - start
+    execs = loop.stats.testcases - start_count
+    execs_per_sec = execs / elapsed
+
+    bochs_equiv = 200_000.0  # see module docstring
+    print(json.dumps({
+        "metric": "exec/s/chip (demo_tlv snapshot fuzz, coverage-guided)",
+        "value": round(execs_per_sec, 1),
+        "unit": "execs/s",
+        "vs_baseline": round(execs_per_sec / bochs_equiv, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

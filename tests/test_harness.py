@@ -1,0 +1,128 @@
+"""End-to-end harness tests: targets + backends + fuzz loop.
+
+Validates VERDICT round-1 exit criteria:
+  - the canonical per-testcase sequence (InsertTestcase -> Run -> Restore,
+    reference client.cc:88-180) behaves identically on the emu and tpu
+    backends;
+  - a synthetic user-mode target's OOB write surfaces as a named crash
+    end-to-end;
+  - the coverage->corpus->mutate feedback loop actually guides (maze).
+"""
+
+import random
+
+import pytest
+
+from wtf_tpu.backend import create_backend
+from wtf_tpu.core.results import Crash, Ok
+from wtf_tpu.fuzz.corpus import Corpus
+from wtf_tpu.fuzz.loop import FuzzLoop
+from wtf_tpu.fuzz.mutator import ByteMutator, TlvStructureMutator
+from wtf_tpu.harness import demo_maze, demo_tlv
+
+
+def tlv(*records) -> bytes:
+    out = b""
+    for rtype, payload in records:
+        out += bytes([rtype, len(payload)]) + payload
+    return out
+
+
+BENIGN = tlv((1, bytes([5, 6, 7])), (2, b"ABCDEFGH"), (3, b"ok"))
+# type-3 payload long enough to smash the saved return address
+OVERFLOW = tlv((3, b"A" * 64))
+
+
+def make_backend(name, target_mod, **kw):
+    snapshot = target_mod.build_snapshot()
+    backend = create_backend(name, snapshot, **kw)
+    backend.initialize()
+    target_mod.TARGET.init(backend)
+    return backend
+
+
+@pytest.mark.parametrize("backend_name", ["emu", "tpu"])
+def test_tlv_benign_and_overflow(backend_name):
+    backend = make_backend(backend_name, demo_tlv, n_lanes=4) \
+        if backend_name == "tpu" else make_backend(backend_name, demo_tlv)
+    target = demo_tlv.TARGET
+
+    results = backend.run_batch([BENIGN, OVERFLOW], target)
+    assert isinstance(results[0], Ok), results[0]
+    assert isinstance(results[1], Crash), results[1]
+    assert results[1].name.startswith("crash-")
+    backend.restore()
+
+    # deterministic across a restore (the checkpoint property, SURVEY §5.4)
+    results2 = backend.run_batch([BENIGN, OVERFLOW], target)
+    assert type(results2[0]) is type(results[0])
+    assert isinstance(results2[1], Crash)
+    assert results2[1].name == results[1].name
+
+
+def test_tlv_backends_agree():
+    emu = make_backend("emu", demo_tlv)
+    tpu = make_backend("tpu", demo_tlv, n_lanes=4)
+    cases = [
+        BENIGN,
+        OVERFLOW,
+        b"",
+        b"\x01",                      # truncated header
+        tlv((1, b"\xff" * 255)),      # max-len sum
+        tlv((9, b"skip me"), (1, b"\x01\x02")),
+        tlv((2, b"1234567")),         # type-2 below threshold
+        tlv((3, b"B" * 17)),          # overflow into saved rbp only
+    ]
+    r_emu = emu.run_batch(cases, demo_tlv.TARGET)
+    r_tpu = tpu.run_batch(cases, demo_tlv.TARGET)
+    for i, (a, b) in enumerate(zip(r_emu, r_tpu)):
+        assert type(a) is type(b), f"case {i}: emu={a} tpu={b}"
+        if isinstance(a, Crash):
+            assert a.name == b.name, f"case {i}: emu={a} tpu={b}"
+
+
+def test_tlv_sum_semantics():
+    """The benign path computes over guest state we can check: rbx returns
+    the sum of type-1 payload bytes via rax at the stop breakpoint."""
+    got = {}
+
+    def grab_and_stop(backend):
+        got["rax"] = backend.get_reg(0)
+        backend.stop(Ok())
+
+    for name in ("emu", "tpu"):
+        backend = make_backend(name, demo_tlv, **(
+            {"n_lanes": 2} if name == "tpu" else {}))
+        backend.set_breakpoint(demo_tlv.FINISH_GVA, grab_and_stop)
+        backend.run_batch([tlv((1, bytes([10, 20, 30])))], demo_tlv.TARGET)
+        assert got["rax"] == 60, name
+
+
+@pytest.mark.parametrize("backend_name", ["emu", "tpu"])
+def test_maze_guided_fuzz_finds_crash(backend_name):
+    target_mod = demo_maze
+    backend = make_backend(backend_name, target_mod, **(
+        {"n_lanes": 32} if backend_name == "tpu" else {}))
+    rng = random.Random(1234)
+    corpus = Corpus(rng=rng)
+    corpus.add(b"aaaa")
+    mutator = ByteMutator(rng, max_len=8)
+    loop = FuzzLoop(backend, target_mod.TARGET, mutator, corpus,
+                    batch_size=32 if backend_name == "tpu" else 8)
+    stats = loop.fuzz(runs=120_000, stop_on_crash=True)
+    assert stats.crashes >= 1, (
+        f"no crash after {stats.testcases} testcases "
+        f"(corpus={len(corpus)})")
+    # guidance evidence: intermediate stages entered the corpus
+    assert len(corpus) >= 3, len(corpus)
+
+
+def test_tlv_structure_mutator_shapes():
+    rng = random.Random(7)
+    m = TlvStructureMutator(rng, max_len=256)
+    corpus = Corpus(rng=rng)
+    corpus.add(BENIGN)
+    for _ in range(100):
+        tc = m.get_new_testcase(corpus)
+        assert len(tc) <= 256
+    assert m.get_new_testcase(None)  # empty-corpus generation works

@@ -187,6 +187,37 @@ class Backend(abc.ABC):
         the name becomes the on-disk filename under crashes/."""
         self.stop(Crash(f"crash-{exception_kind}-{exception_address:#x}"))
 
+    # -- batch facade ------------------------------------------------------
+    def run_batch(self, insert: List[bytes], target) -> List[TestcaseResult]:
+        """Run a list of testcases; returns one result each.
+
+        Single-lane backends iterate the reference's canonical per-testcase
+        sequence (client.cc:88-180: InsertTestcase -> Run -> Restore),
+        restoring between testcases; the batch backend overrides this with
+        one device dispatch for the whole list.  The final restore is the
+        caller's (fuzz loop's) responsibility either way."""
+        results: List[TestcaseResult] = []
+        self._batch_new: List[bool] = []
+        for i, data in enumerate(insert):
+            if i > 0:
+                target.restore()
+                self.restore()
+            target.insert_testcase(self, data)
+            result = self.run()
+            if isinstance(result, type(None)):
+                raise AssertionError("run() returned None")
+            from wtf_tpu.core.results import Timedout
+            if isinstance(result, Timedout):
+                self.revoke_last_new_coverage()
+                self._batch_new.append(False)
+            else:
+                self._batch_new.append(bool(self.last_new_coverage()))
+            results.append(result)
+        return results
+
+    def lane_found_new_coverage(self, lane: int) -> bool:
+        return self._batch_new[lane]
+
     # -- misc --------------------------------------------------------------
     def set_trace_file(self, path, trace_type: str) -> None:
         """Arrange for a rip/cov trace of the next run (reference

@@ -114,9 +114,21 @@ class TpuBackend(Backend):
         insert: Optional[Sequence] = None,
         target=None,
     ) -> List[TestcaseResult]:
-        """Run one batch.  `insert` is a list of testcase buffers (one per
-        lane; shorter lists leave trailing lanes idle); `target` supplies
-        insert_testcase(backend, data).  Statuses -> TestcaseResults."""
+        """Run a batch of testcases (one per lane; lists longer than
+        n_lanes run as several device rounds with a restore in between;
+        shorter lists leave trailing lanes idle)."""
+        if insert is not None and len(insert) > self.n_lanes:
+            results: List[TestcaseResult] = []
+            flags: List[bool] = []
+            for start in range(0, len(insert), self.n_lanes):
+                if start > 0:
+                    target.restore()
+                    self.restore()
+                chunk = insert[start:start + self.n_lanes]
+                results.extend(self.run_batch(chunk, target))
+                flags.extend(self._new_lane[:len(chunk)])
+            self._new_lane = np.array(flags)
+            return results
         runner = self.runner
         runner.limit = self.limit
         self._lane_results = {}

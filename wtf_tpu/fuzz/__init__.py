@@ -1,0 +1,13 @@
+"""Fuzz plane: corpus management + mutation engines (SURVEY.md §2.3).
+
+  corpus.py  - in-memory corpus with digest-named persistence
+               (reference src/wtf/corpus.h)
+  mutator.py - mutator interface + byte-level and honggfuzz-mangle-style
+               engines + the structure-aware TLV example
+               (reference src/wtf/mutator.{h,cc}, honggfuzz.cc:836)
+"""
+
+from wtf_tpu.fuzz.corpus import Corpus  # noqa: F401
+from wtf_tpu.fuzz.mutator import (  # noqa: F401
+    ByteMutator, MangleMutator, Mutator, TlvStructureMutator, create_mutator,
+)

@@ -1,0 +1,64 @@
+"""Corpus: the set of coverage-increasing testcases.
+
+Reference `Corpus_t` (src/wtf/corpus.h): an in-memory vector of buffers with
+uniform-random `PickTestcase` (corpus.h:89-102) and digest-named saves into
+outputs/ (corpus.h:56-87; names are content hashes so re-finding the same
+input is a no-op).
+"""
+
+from __future__ import annotations
+
+import random
+from pathlib import Path
+from typing import List, Optional
+
+from wtf_tpu.utils.hashing import hex_digest
+
+
+class Corpus:
+    def __init__(self, outputs_dir: Optional[Path] = None,
+                 rng: Optional[random.Random] = None):
+        self.outputs_dir = Path(outputs_dir) if outputs_dir else None
+        if self.outputs_dir:
+            self.outputs_dir.mkdir(parents=True, exist_ok=True)
+        self.rng = rng or random.Random()
+        self._items: List[bytes] = []
+        self._digests = set()
+        self.bytes_total = 0
+
+    def add(self, data: bytes) -> bool:
+        """Insert + persist; returns False for duplicates (content hash)."""
+        digest = hex_digest(data)
+        if digest in self._digests:
+            return False
+        self._digests.add(digest)
+        self._items.append(data)
+        self.bytes_total += len(data)
+        if self.outputs_dir:
+            (self.outputs_dir / digest).write_bytes(data)
+        return True
+
+    def pick(self) -> Optional[bytes]:
+        """Uniform random pick (corpus.h:89-102); None while empty."""
+        if not self._items:
+            return None
+        return self.rng.choice(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    @staticmethod
+    def load_dir(path: Path, rng: Optional[random.Random] = None,
+                 outputs_dir: Optional[Path] = None) -> "Corpus":
+        """Seed from a directory of input files, biggest first (the
+        reference master replays inputs/ sorted by size, server.h:399-414)."""
+        corpus = Corpus(outputs_dir=outputs_dir, rng=rng)
+        files = sorted(Path(path).glob("*"),
+                       key=lambda p: p.stat().st_size, reverse=True)
+        for f in files:
+            if f.is_file():
+                corpus.add(f.read_bytes())
+        return corpus

@@ -1,0 +1,130 @@
+"""The standalone fuzz loop: mutate -> batch-execute -> harvest.
+
+This is the single-process campaign driver — the reference needs a master
+process + N client processes even on one machine (README.md:34-110); here
+one process drives a whole device batch, and the distributed mode
+(dist/client.py speaking to dist/server.py) reuses the same harvest logic
+per node.
+
+Per batch (the batched RunTestcaseAndRestore, client.cc:88-180):
+  1. draw one testcase per lane from the mutator (corpus-seeded)
+  2. backend.run_batch: insert + run every lane
+  3. harvest: new-coverage lanes -> corpus + mutator cross-over seed;
+     crashes -> crashes/<name>; timeouts already coverage-revoked
+  4. target.restore + backend.restore
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from pathlib import Path
+from typing import Optional
+
+from wtf_tpu.core.results import Crash, Cr3Change, Ok, Timedout
+from wtf_tpu.fuzz.corpus import Corpus
+from wtf_tpu.fuzz.mutator import Mutator
+from wtf_tpu.utils.hashing import hex_digest
+from wtf_tpu.utils.human import seconds_to_human
+
+
+class CampaignStats:
+    """Counters behind the status line (reference ServerStats_t / client
+    stats, server.h:24-240, client.cc:7-84)."""
+
+    def __init__(self):
+        self.testcases = 0
+        self.crashes = 0
+        self.timeouts = 0
+        self.cr3s = 0
+        self.new_coverage = 0
+        self.start = time.time()
+        self.last_print = 0.0
+
+    def execs_per_sec(self) -> float:
+        dt = time.time() - self.start
+        return self.testcases / dt if dt > 0 else 0.0
+
+    def line(self, corpus_len: int, cov: int) -> str:
+        uptime = seconds_to_human(time.time() - self.start)
+        return (f"#{self.testcases} cov: {cov} corp: {corpus_len} "
+                f"exec/s: {self.execs_per_sec():.1f} "
+                f"crash: {self.crashes} timeout: {self.timeouts} "
+                f"cr3: {self.cr3s} uptime: {uptime}")
+
+
+class FuzzLoop:
+    def __init__(
+        self,
+        backend,
+        target,
+        mutator: Mutator,
+        corpus: Corpus,
+        crashes_dir: Optional[Path] = None,
+        batch_size: Optional[int] = None,
+        stats_every: float = 10.0,
+    ):
+        self.backend = backend
+        self.target = target
+        self.mutator = mutator
+        self.corpus = corpus
+        self.crashes_dir = Path(crashes_dir) if crashes_dir else None
+        if self.crashes_dir:
+            self.crashes_dir.mkdir(parents=True, exist_ok=True)
+        self.batch_size = batch_size or getattr(backend, "n_lanes", 1)
+        self.stats = CampaignStats()
+        self.stats_every = stats_every
+        self.crash_names = set()
+
+    def run_one_batch(self) -> int:
+        """Returns the number of crashes found in this batch."""
+        testcases = [self.mutator.get_new_testcase(self.corpus)
+                     for _ in range(self.batch_size)]
+        results = self.backend.run_batch(testcases, self.target)
+        crashes = 0
+        for lane, (data, result) in enumerate(zip(testcases, results)):
+            self.stats.testcases += 1
+            if isinstance(result, Timedout):
+                self.stats.timeouts += 1
+            elif isinstance(result, Cr3Change):
+                self.stats.cr3s += 1
+            elif isinstance(result, Crash):
+                self.stats.crashes += 1
+                crashes += 1
+                self._save_crash(data, result)
+            if self.backend.lane_found_new_coverage(lane):
+                self.stats.new_coverage += 1
+                if self.corpus.add(data):
+                    self.mutator.on_new_coverage(data)
+        self.target.restore()
+        self.backend.restore()
+        return crashes
+
+    def _save_crash(self, data: bytes, result: Crash) -> None:
+        name = result.name or f"crash-{hex_digest(data)[:16]}"
+        self.crash_names.add(name)
+        if self.crashes_dir:
+            (self.crashes_dir / name).write_bytes(data)
+
+    def fuzz(self, runs: int, print_stats: bool = False,
+             stop_on_crash: bool = False) -> CampaignStats:
+        """Run until `runs` testcases executed (0 = forever)."""
+        while runs == 0 or self.stats.testcases < runs:
+            found = self.run_one_batch()
+            now = time.time()
+            if print_stats and now - self.stats.last_print >= self.stats_every:
+                self.stats.last_print = now
+                print(self.stats.line(len(self.corpus), self._coverage()))
+            if stop_on_crash and found:
+                break
+        return self.stats
+
+    def _coverage(self) -> int:
+        try:
+            import numpy as np
+
+            return int(np.count_nonzero(
+                np.unpackbits(
+                    np.asarray(self.backend._agg_cov).view("uint8"))))
+        except Exception:
+            return len(getattr(self.backend, "_aggregate_cov", ()))

@@ -278,10 +278,15 @@ def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
         view.r["xmm"][lane, i, 1] = np.uint64(cpu.xmm[i][1] & MASK64)
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@jax.jit
 def _apply_page_writes(machine: Machine, lanes, pfns, pages, valid):
     """Apply K buffered (lane, pfn, page) writes into the batched overlay in
-    one device call (lax.scan; K is padded to a bucket size host-side)."""
+    one device call (lax.scan; K is padded to a bucket size host-side).
+
+    NOTE: no buffer donation — after machine_restore the machine shares the
+    template's buffers, and donating them would invalidate the template for
+    every later restore.  (Perf follow-up: keep the template host-side so
+    run_chunk/_apply calls can donate safely.)"""
     capacity = machine.overlay.pfn.shape[1]
 
     def body(overlay, item):
@@ -386,6 +391,8 @@ class Runner:
         except HostFault:
             self.lane_errors[lane] = f"fetch fault @ {rip:#x}"
             view.set_status(lane, StatusCode.PAGE_FAULT)
+            view.r["fault_gva"][lane] = np.uint64(rip & MASK64)
+            view.r["fault_write"][lane] = np.int32(0)
             return False
         uop = decode(window, rip)
         try:

@@ -1,0 +1,12 @@
+"""Multi-chip scaling: shard the lane axis over a device mesh.
+
+The reference scales by running N independent client *processes* against one
+master over TCP (SURVEY.md §2.7); the TPU-native equivalent keeps ONE batch
+whose lane axis is sharded across chips with `jax.sharding` — XLA inserts
+the ICI collectives (the coverage OR-reduce becomes an all-reduce) and the
+host runner stays oblivious.
+"""
+
+from wtf_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, merged_coverage, shard_machine,
+)

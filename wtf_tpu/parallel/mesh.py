@@ -1,0 +1,75 @@
+"""Lane-axis sharding over a jax.sharding.Mesh.
+
+Design (SURVEY.md §2.7.3): the fuzzer's only parallel axis is *testcases*
+(lanes) — the analog of data parallelism.  Machine state is SoA arrays with
+a leading lane axis, so sharding is one PartitionSpec over that axis; the
+snapshot image and uop table are replicated (every chip interprets against
+the same read-only memory image); coverage aggregation is an OR-reduce over
+the lane axis, which XLA turns into an ICI all-reduce when lanes span chips.
+
+Multi-host: the same mesh spans processes (jax distributed runtime); the
+corpus/crash plane stays host-side and distributes over the reference's TCP
+protocol (dist/), which needs no device awareness.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from wtf_tpu.interp.machine import Machine
+
+LANE_AXIS = "lanes"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (LANE_AXIS,))
+
+
+def shard_machine(machine: Machine, mesh: Mesh) -> Machine:
+    """Place every per-lane leaf with its leading axis split over the mesh.
+
+    n_lanes must divide by mesh size.  Returns the same pytree with
+    device-sharded arrays; everything downstream (run_chunk, coverage
+    merge) is shape-identical, so jit compiles SPMD executables with XLA
+    inserting the cross-chip collectives."""
+    sharding = NamedSharding(mesh, P(LANE_AXIS))
+
+    def place(leaf):
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree.map(place, machine)
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate snapshot image / uop table on every mesh device."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), tree)
+
+
+def _or_reduce_lanes(words):
+    """OR-reduce u32 bitmaps over the (possibly sharded) lane axis.
+
+    Formulated as bit-unpack -> jnp.any -> repack because XLA's cross-device
+    reduction set covers boolean OR but not u32 bitwise-or; jnp.any over a
+    sharded axis lowers to the ICI all-reduce we want."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)   # [L, W, 32]
+    any_bits = jnp.any(bits != 0, axis=0)                 # [W, 32]
+    return jnp.sum(any_bits.astype(jnp.uint32) << shifts, axis=-1)
+
+
+@jax.jit
+def merged_coverage(machine: Machine):
+    """Batch-wide coverage union: OR-reduce the per-lane cov/edge bitmaps
+    over the lane axis.  Under a sharded lane axis this lowers to an
+    all-reduce over ICI — the device-side replacement for the reference
+    master's set-union merge (server.h:816-854)."""
+    return _or_reduce_lanes(machine.cov), _or_reduce_lanes(machine.edge)
