@@ -1,6 +1,6 @@
 """Benchmark: fuzzing throughput of the TPU backend on the demo_tlv target.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Metric: testcase executions per second per chip on the synthetic TLV-parser
 snapshot (the reference's headline number is execs/s of its backends on its
@@ -14,16 +14,24 @@ emulator sustains ~50M instr/s on one host core, and this workload executes
 ~250 instructions/testcase plus a full dirty-page restore, so the bochscpu
 role is estimated at 50e6/250 = 200k execs/s-equivalent... that flatters
 bochs (restore ignored), which is the conservative direction for us.
+
+Robustness (BENCH_r02 died in TPU client init before measuring anything):
+the measurement runs in a supervised subprocess with a hard timeout; on
+init failure or hang it retries once, then falls back to the CPU platform.
+The supervisor ALWAYS prints the one JSON line.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-os.environ.setdefault("XLA_FLAGS", "")
+BOCHS_EQUIV = 200_000.0  # see module docstring
 
 
-def main():
+def worker() -> None:
+    """The actual measurement (runs in a subprocess; may be told cpu)."""
     import random
 
     from wtf_tpu.backend import create_backend
@@ -32,7 +40,18 @@ def main():
     from wtf_tpu.fuzz.mutator import MangleMutator
     from wtf_tpu.harness import demo_tlv
 
-    n_lanes = int(os.environ.get("BENCH_LANES", "256"))
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    n_lanes = int(os.environ.get("BENCH_LANES", "1024"))
     seconds = float(os.environ.get("BENCH_SECONDS", "20"))
 
     snapshot = demo_tlv.build_snapshot()
@@ -59,14 +78,106 @@ def main():
     execs = loop.stats.testcases - start_count
     execs_per_sec = execs / elapsed
 
-    bochs_equiv = 200_000.0  # see module docstring
-    print(json.dumps({
+    # headline result is complete here; the optional microbench must not be
+    # able to lose it (the round-2 failure mode: die before reporting)
+    report = {
         "metric": "exec/s/chip (demo_tlv snapshot fuzz, coverage-guided)",
         "value": round(execs_per_sec, 1),
         "unit": "execs/s",
-        "vs_baseline": round(execs_per_sec / bochs_equiv, 4),
+        "vs_baseline": round(execs_per_sec / BOCHS_EQUIV, 4),
+        "platform": platform,
+        "lanes": n_lanes,
+    }
+    try:
+        report["microbench"] = _microbench(snapshot)
+    except Exception as e:  # noqa: BLE001
+        report["microbench"] = {"error": str(e)[:200]}
+    print(json.dumps(report))
+
+
+def _microbench(snapshot) -> dict:
+    """Device instructions/s for a straight-line and a branchy guest
+    workload, plus the per-chunk servicing floor (VERDICT round-2 item 7:
+    measure before optimizing the hot path)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from wtf_tpu.harness import demo_tlv
+    from wtf_tpu.interp.runner import Runner, warm_decode_cache
+
+    out = {}
+    n_lanes = int(os.environ.get("BENCH_MICRO_LANES", "1024"))
+    r = Runner(snapshot, n_lanes=n_lanes, chunk_steps=512)
+    # warm decode cache via the oracle on a long type-1 (sum loop) workload:
+    # branchy (loop back-edge + record dispatch) — the realistic shape
+    payload = b"\x01\x08AAAAAAAA" * 100
+    warm_decode_cache(r, demo_tlv.TARGET, payload)
+    view = r.view()
+    for lane in range(n_lanes):
+        view.virt_write(lane, demo_tlv.INPUT_GVA, payload)
+        view.r["gpr"][lane, 2] = np.uint64(len(payload))
+    r.push(view)
+    tab = r.cache.device()
+    rc = r._run_chunk
+    m = rc(tab, r.physmem.image, r.machine, jnp.uint64(1 << 40))
+    m.status.block_until_ready()  # compile + first chunk
+    t0 = time.time()
+    m2 = rc(tab, r.physmem.image, m, jnp.uint64(1 << 40))
+    m2.status.block_until_ready()
+    dt = time.time() - t0
+    instr = int((np.asarray(m2.icount) - np.asarray(m.icount)).sum())
+    out["branchy_instr_per_s"] = round(instr / dt, 1)
+    out["chunk512_wall_s"] = round(dt, 4)
+    # servicing floor: chunk call with every lane terminal (early exit) —
+    # pure dispatch+transfer overhead per host<->device round trip
+    t0 = time.time()
+    from wtf_tpu.core.results import StatusCode
+
+    m3 = rc(tab, r.physmem.image,
+            m2._replace(status=jnp.full_like(m2.status, int(StatusCode.OK))),
+            jnp.uint64(1 << 40))
+    m3.status.block_until_ready()
+    out["chunk_dispatch_floor_s"] = round(time.time() - t0, 4)
+    return out
+
+
+def main() -> None:
+    # total budget divided across attempts so a hanging TPU init can never
+    # push the final (cpu) attempt past the driver's outer timeout
+    budget = float(os.environ.get("BENCH_TIMEOUT", "1800"))
+    per = budget / 3
+    attempts = [
+        ({}, per),          # native platform (tpu when available)
+        ({}, per),          # retry once: tunnel inits are flaky
+        ({"BENCH_PLATFORM": "cpu"}, per),  # degraded: measure on cpu
+    ]
+    last_err = "no attempts ran"
+    for extra_env, tmo in attempts:
+        env = dict(os.environ, **extra_env)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                env=env, timeout=tmo, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            last_err = f"worker timed out after {tmo}s"
+            continue
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            print(line)
+            return
+        last_err = (proc.stderr.strip().splitlines() or ["worker failed"])[-1]
+    print(json.dumps({
+        "metric": "exec/s/chip (demo_tlv snapshot fuzz, coverage-guided)",
+        "value": 0.0,
+        "unit": "execs/s",
+        "vs_baseline": 0.0,
+        "error": last_err[:500],
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        main()

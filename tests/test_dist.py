@@ -1,0 +1,163 @@
+"""Distribution-plane tests: wire protocol, master reactor, node loops.
+
+The reference's cheap localhost story (SURVEY.md §4.5): master + fuzz
+processes on one machine over tcp://localhost or a unix socket.  Here the
+master runs on a thread and the nodes in the test thread — the protocol
+crosses a real socketpair either way.
+"""
+
+import random
+import threading
+from pathlib import Path
+
+import pytest
+
+from wtf_tpu.backend import create_backend
+from wtf_tpu.core.results import Crash, Cr3Change, Ok, Timedout
+from wtf_tpu.dist import BatchClient, Client, Server, wire
+from wtf_tpu.fuzz.corpus import Corpus
+from wtf_tpu.fuzz.mutator import TlvStructureMutator
+from wtf_tpu.harness import demo_tlv
+
+from test_harness import BENIGN, OVERFLOW, tlv
+
+
+# ---------------------------------------------------------------------------
+# wire
+# ---------------------------------------------------------------------------
+
+def test_parse_address():
+    import socket
+
+    assert wire.parse_address("tcp://localhost:31337/") == (
+        socket.AF_INET, ("localhost", 31337))
+    assert wire.parse_address("tcp://10.0.0.1:50") == (
+        socket.AF_INET, ("10.0.0.1", 50))
+    assert wire.parse_address("unix:///tmp/x.sock") == (
+        socket.AF_UNIX, "/tmp/x.sock")
+    for bad in ("tcp://nohost/", "udp://x:1/", "unix://"):
+        with pytest.raises(ValueError):
+            wire.parse_address(bad)
+
+
+@pytest.mark.parametrize("result", [
+    Ok(), Timedout(), Cr3Change(), Crash("crash-write-0xdead"), Crash(None),
+])
+def test_result_roundtrip(result):
+    tc = b"\x01\x02some testcase"
+    cov = {0x1400001000, 0x1400001005, 0x7fff0000}
+    body = wire.encode_result(tc, cov, result)
+    tc2, cov2, result2 = wire.decode_result(body)
+    assert tc2 == tc
+    assert cov2 == cov
+    assert type(result2) is type(result)
+    if isinstance(result, Crash):
+        assert result2.name == result.name
+
+
+def test_framing_roundtrip():
+    import socket
+
+    a, b = socket.socketpair()
+    try:
+        wire.send_msg(a, b"hello")
+        wire.send_msg(a, b"")
+        assert wire.recv_msg(b) == b"hello"
+        assert wire.recv_msg(b) == b""
+        a.close()
+        assert wire.recv_msg(b) is None  # peer closed -> None
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# master + nodes end to end (emu backend: fast, deterministic)
+# ---------------------------------------------------------------------------
+
+def _addr(tmp_path: Path) -> str:
+    return f"unix://{tmp_path}/master.sock"
+
+
+def _serve(server, seconds=60.0):
+    t = threading.Thread(target=server.run, kwargs={"max_seconds": seconds})
+    t.start()
+    return t
+
+
+def test_minset_mode(tmp_path):
+    """runs=0: replay the seeds only; outputs/ = coverage-minimal subset
+    (reference --runs=0 minset, server.h:552-556, README.md:81-92)."""
+    inputs = tmp_path / "inputs"
+    inputs.mkdir()
+    # two seeds with identical coverage + one that adds coverage: the
+    # minset must keep one of the twins, drop the other
+    (inputs / "twin_a").write_bytes(tlv((1, b"\x01\x02")))
+    (inputs / "twin_b").write_bytes(tlv((1, b"\x09\x08")))
+    (inputs / "stores").write_bytes(tlv((2, b"ABCDEFGH")))
+    rng = random.Random(1)
+    corpus = Corpus(outputs_dir=tmp_path / "outputs", rng=rng)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    inputs_dir=inputs, runs=0)
+    thread = _serve(server)
+    backend = create_backend("emu", demo_tlv.build_snapshot())
+    backend.initialize()
+    client = Client(backend, demo_tlv.TARGET, _addr(tmp_path))
+    served = client.run()
+    thread.join(timeout=60)
+    assert not thread.is_alive()
+    assert served == 3
+    assert server.stats.testcases == 3
+    saved = list((tmp_path / "outputs").iterdir())
+    assert len(saved) == 2, [p.name for p in saved]  # one twin + stores
+
+
+def test_fuzz_to_crash_single_client(tmp_path):
+    """Master + one emu node fuzz demo_tlv to the planted stack smash."""
+    rng = random.Random(0x5EED)
+    corpus = Corpus(rng=rng)
+    corpus.add(BENIGN)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 128), corpus,
+                    crashes_dir=tmp_path / "crashes", runs=800)
+    thread = _serve(server, seconds=120)
+    backend = create_backend("emu", demo_tlv.build_snapshot(), limit=50_000)
+    backend.initialize()
+    client = Client(backend, demo_tlv.TARGET, _addr(tmp_path))
+    client.run()
+    thread.join(timeout=120)
+    assert not thread.is_alive()
+    assert server.stats.crashes >= 1, server.stats.testcases
+    crashes = list((tmp_path / "crashes").iterdir())
+    assert crashes, "no crash file saved"
+    assert any(p.name.startswith("crash-") for p in crashes)
+    assert len(server.coverage) > 0
+
+
+def test_batch_client_looks_like_n_nodes(tmp_path):
+    """A TPU batch node is indistinguishable from n_lanes ordinary nodes:
+    the master (unmodified) feeds it per-connection and aggregates per-lane
+    results (the BASELINE.json master-oblivious property)."""
+    rng = random.Random(3)
+    corpus = Corpus(rng=rng)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    crashes_dir=tmp_path / "crashes", runs=8)
+    # seed paths so the first batch round is fully deterministic
+    inputs = tmp_path / "inputs"
+    inputs.mkdir()
+    (inputs / "a").write_bytes(BENIGN)
+    (inputs / "b").write_bytes(OVERFLOW)
+    (inputs / "c").write_bytes(tlv((2, b"ABCDEFGH")))
+    (inputs / "d").write_bytes(tlv((1, b"\x05")))
+    server.paths = [p.read_bytes() for p in sorted(
+        inputs.iterdir(), key=lambda p: p.stat().st_size, reverse=True)]
+    thread = _serve(server, seconds=180)
+    backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                             n_lanes=4, limit=50_000)
+    backend.initialize()
+    node = BatchClient(backend, demo_tlv.TARGET, _addr(tmp_path))
+    served = node.run(max_rounds=3)
+    thread.join(timeout=180)
+    assert not thread.is_alive()
+    assert served == server.stats.testcases == 12  # 4 seeds + 8 mutations
+    assert server.stats.crashes >= 1  # OVERFLOW seed crashed
+    assert len(server.coverage) > 0
+    assert len(corpus) >= 1

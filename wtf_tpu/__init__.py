@@ -22,6 +22,8 @@ Layering (mirrors SURVEY.md section 1's layer map, redesigned TPU-first):
   cli.py    - `master|fuzz|run` subcommands                          (L6)
 """
 
+import os
+
 import jax
 
 # The guest is an x86-64 machine: 64-bit GPRs, 64-bit linear addresses.
@@ -29,5 +31,17 @@ import jax
 # ops to 32-bit pairs on TPU; correctness first, the Pallas hot path works on
 # packed 32-bit lanes).
 jax.config.update("jax_enable_x64", True)
+
+# Honor JAX_PLATFORMS explicitly: some environments pre-register a TPU PJRT
+# plugin from sitecustomize and force the platform over the env var, which
+# makes `JAX_PLATFORMS=cpu python -m wtf_tpu ...` silently (or hangingly)
+# target the chip.  An explicit config update wins as long as no backend
+# has been initialized yet.
+_platforms = os.environ.get("JAX_PLATFORMS")
+if _platforms and _platforms != "axon":
+    try:
+        jax.config.update("jax_platforms", _platforms)
+    except Exception:
+        pass
 
 __version__ = "0.1.0"

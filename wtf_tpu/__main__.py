@@ -1,0 +1,7 @@
+"""`python -m wtf_tpu` -> CLI (wtf_tpu/cli.py)."""
+
+import sys
+
+from wtf_tpu.cli import main
+
+sys.exit(main())

@@ -47,21 +47,30 @@ _STATUS_TERMINAL_MAP = {
 }
 
 
-def _or_reduce(x):
-    return jax.lax.reduce(x, np.uint32(0), jax.lax.bitwise_or, (0,))
-
-
 @jax.jit
 def _merge_coverage(agg_cov, agg_edge, cov, edge, include):
-    """OR lane bitmaps (where `include`) into the aggregates; per-lane
-    new-coverage flags computed against the pre-merge aggregate."""
-    new_lane = (jnp.any((cov & ~agg_cov[None, :]) != 0, axis=1)
-                | jnp.any((edge & ~agg_edge[None, :]) != 0, axis=1))
+    """OR lane bitmaps (where `include`) into the aggregates.
+
+    Per-lane new-coverage credit follows the reference master's *sequential*
+    set-union merge (server.h:816-854): a lane counts as new only for bits
+    not in the aggregate AND not already contributed by a lower lane of the
+    same batch (cumulative-OR prefix).  Without this, every lane finding the
+    same new edge enters the corpus, polluting it with coverage-duplicate
+    testcases and measurably diluting guided search."""
     inc = include[:, None]
     cov_in = jnp.where(inc, cov, 0)
     edge_in = jnp.where(inc, edge, 0)
-    cov_union = _or_reduce(cov_in)
-    edge_union = _or_reduce(edge_in)
+    cum_cov = jax.lax.associative_scan(jnp.bitwise_or, cov_in, axis=0)
+    cum_edge = jax.lax.associative_scan(jnp.bitwise_or, edge_in, axis=0)
+    prev_cov = jnp.concatenate(
+        [jnp.zeros_like(cov_in[:1]), cum_cov[:-1]], axis=0)
+    prev_edge = jnp.concatenate(
+        [jnp.zeros_like(edge_in[:1]), cum_edge[:-1]], axis=0)
+    new_lane = (
+        jnp.any((cov_in & ~agg_cov[None, :] & ~prev_cov) != 0, axis=1)
+        | jnp.any((edge_in & ~agg_edge[None, :] & ~prev_edge) != 0, axis=1))
+    cov_union = cum_cov[-1]
+    edge_union = cum_edge[-1]
     new_cov_words = cov_union & ~agg_cov
     return (agg_cov | cov_union, agg_edge | edge_union,
             new_lane & include, new_cov_words)
@@ -161,6 +170,14 @@ class TpuBackend(Backend):
     def lane_found_new_coverage(self, lane: int) -> bool:
         return bool(self._new_lane[lane])
 
+    def lane_coverage(self, lane: int) -> Set[int]:
+        """This lane's executed-RIP set from its device bitmap (valid after
+        run_batch, before restore).  Edge-hash coverage stays device-side;
+        the wire protocol reports RIP coverage like the reference's
+        robin_set<Gva_t> (client.cc:187-200)."""
+        cov = np.asarray(self.runner.machine.cov)[lane]
+        return set(self.runner.cache.rips_of_bits(cov))
+
     def lane_result_detail(self, lane: int) -> str:
         return self.runner.lane_errors.get(lane, "")
 
@@ -235,14 +252,25 @@ class TpuBackend(Backend):
         emu = EmuBackend(self.snapshot, limit=self.limit)
         emu.initialize()
         emu.breakpoints = dict(self.breakpoints)
-        # replay lane-0 pending state (testcase insertion) onto the oracle
+        # replay lane-0 pending state (testcase insertion) onto the oracle:
+        # memory writes plus the FULL device-resident register set, so the
+        # trace follows the same path the run it reproduces would take
         view = self._ensure_view()
         for (lane, pfn), page in sorted(view.pending.items()):
             if lane == 0:
                 emu.cpu.mem.phys_write(pfn << 12, bytes(page))
-        emu.cpu.gpr = [int(v) for v in view.r["gpr"][0]]
-        emu.cpu.rip = int(view.r["rip"][0])
-        emu.cpu.rflags = int(view.r["rflags"][0])
+        cpu = emu.cpu
+        cpu.gpr = [int(v) for v in view.r["gpr"][0]]
+        cpu.rip = int(view.r["rip"][0])
+        cpu.rflags = int(view.r["rflags"][0])
+        for name in ("fs_base", "gs_base", "kernel_gs_base", "cr0", "cr3",
+                     "cr4", "cr8", "lstar", "star", "sfmask", "tsc"):
+            setattr(cpu, name, int(view.r[name][0]))
+        for i in range(16):
+            cpu.xmm[i][0] = int(view.r["xmm"][0, i, 0])
+            cpu.xmm[i][1] = int(view.r["xmm"][0, i, 1])
+        cpu.icount = int(view.r["icount"][0])
+        cpu.rdrand_state = int(view.r["rdrand"][0])
         self._view = None
         emu.set_trace_file(path, trace_type)
         return emu.run()

@@ -1,0 +1,266 @@
+"""CLI entry point: `python -m wtf_tpu {master|fuzz|run|campaign}`.
+
+Mirror of the reference's wtf.cc:33-371 (CLI11 subcommands + path
+defaulting) and subcommands.cc:16-101 (drivers):
+
+  run       replay input file/dir on a backend, optional rip/cov trace
+            (RunSubcommand, subcommands.cc:16-92)
+  fuzz      node loop: dial the master, execute, report
+            (FuzzSubcommand -> Client_t::Run, subcommands.cc:94-97)
+  master    testcase server: corpus, mutation, coverage aggregation
+            (MasterSubcommand -> Server_t::Run, subcommands.cc:99-101)
+  campaign  single-process fused master+node over one device batch
+            (this framework's native mode; no reference equivalent)
+
+Target selection is by --name over the self-registering target registry;
+--target-module imports additional harness modules first (the reference
+compiles fuzzer_*.cc in; here any importable module registering a Target
+works, wtf.cc:378-383).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import random
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from wtf_tpu.config import (
+    BACKENDS, CampaignOptions, DEFAULT_ADDRESS, FuzzOptions, MasterOptions,
+    RunOptions, TargetPaths, TRACE_TYPES,
+)
+from wtf_tpu.core.results import Crash
+from wtf_tpu.harness.targets import Targets, load_builtin_targets
+
+
+def _add_paths(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--target", type=Path, default=None,
+                   help="target root dir (defaults inputs/outputs/crashes/"
+                        "state underneath, wtf.cc:48-68)")
+    p.add_argument("--inputs", type=Path, default=None)
+    p.add_argument("--outputs", type=Path, default=None)
+    p.add_argument("--crashes", type=Path, default=None)
+    p.add_argument("--state", type=Path, default=None)
+
+
+def _add_target_selection(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--name", required=True, help="registered target name")
+    p.add_argument("--target-module", action="append", default=[],
+                   help="extra python module(s) to import for target "
+                        "registration")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="wtf_tpu",
+        description="TPU-native distributed snapshot fuzzer")
+    sub = parser.add_subparsers(dest="subcommand", required=True)
+
+    run = sub.add_parser("run", help="replay testcases / write traces")
+    _add_target_selection(run)
+    _add_paths(run)
+    run.add_argument("--backend", choices=BACKENDS, default="emu")
+    run.add_argument("--input", type=Path, required=True,
+                     help="testcase file or directory")
+    run.add_argument("--limit", type=int, default=0,
+                     help="instruction budget per testcase (0 = none)")
+    run.add_argument("--runs", type=int, default=1,
+                     help="times to run each testcase")
+    run.add_argument("--trace-path", type=Path, default=None,
+                     help="file (single input) or dir to write traces")
+    run.add_argument("--trace-type", choices=TRACE_TYPES, default="rip")
+    run.add_argument("--lanes", type=int, default=4)
+
+    fuzz = sub.add_parser("fuzz", help="fuzz node (dials the master)")
+    _add_target_selection(fuzz)
+    _add_paths(fuzz)
+    fuzz.add_argument("--backend", choices=BACKENDS, default="tpu")
+    fuzz.add_argument("--limit", type=int, default=0)
+    fuzz.add_argument("--address", default=DEFAULT_ADDRESS)
+    fuzz.add_argument("--seed", type=int, default=0)
+    fuzz.add_argument("--lanes", type=int, default=64)
+
+    master = sub.add_parser("master", help="master node (serves testcases)")
+    _add_target_selection(master)
+    _add_paths(master)
+    master.add_argument("--address", default=DEFAULT_ADDRESS)
+    master.add_argument("--runs", type=int, default=0,
+                        help="mutation budget; 0 = minset over inputs/")
+    master.add_argument("--max_len", type=int, default=1024 * 1024)
+    master.add_argument("--seed", type=int, default=0)
+
+    camp = sub.add_parser(
+        "campaign", help="single-process fused master+node fuzz loop")
+    _add_target_selection(camp)
+    _add_paths(camp)
+    camp.add_argument("--backend", choices=BACKENDS, default="tpu")
+    camp.add_argument("--limit", type=int, default=0)
+    camp.add_argument("--runs", type=int, default=0)
+    camp.add_argument("--max_len", type=int, default=1024 * 1024)
+    camp.add_argument("--seed", type=int, default=0)
+    camp.add_argument("--lanes", type=int, default=64)
+    camp.add_argument("--stop-on-crash", action="store_true")
+    return parser
+
+
+def _paths_from(args) -> TargetPaths:
+    return TargetPaths(target=args.target, inputs=args.inputs,
+                       outputs=args.outputs, crashes=args.crashes,
+                       state=args.state).resolve()
+
+
+def _lookup_target(args):
+    load_builtin_targets()
+    for module in args.target_module:
+        importlib.import_module(module)
+    return Targets.instance().get(args.name)
+
+
+def _build_backend(target, backend_name: str, paths: TargetPaths,
+                   limit: int, lanes: int):
+    from wtf_tpu.backend import create_backend
+    from wtf_tpu.snapshot.loader import load_snapshot
+
+    if paths.state and Path(paths.state).exists():
+        snapshot = load_snapshot(paths.state)
+    elif target.snapshot is not None:
+        snapshot = target.snapshot()
+    else:
+        raise SystemExit(
+            f"target {target.name!r} has no snapshot factory and no "
+            f"--state dir was given")
+    kwargs = {"n_lanes": lanes} if backend_name == "tpu" else {}
+    backend = create_backend(backend_name, snapshot, limit=limit, **kwargs)
+    backend.initialize()
+    return backend
+
+
+def _mutator_for(target, rng: random.Random, max_len: int):
+    from wtf_tpu.fuzz.mutator import MangleMutator
+
+    if target.create_mutator is not None:
+        return target.create_mutator(rng, max_len)
+    return MangleMutator(rng, max_len)
+
+
+# ---------------------------------------------------------------------------
+# subcommand drivers (subcommands.cc:16-101)
+# ---------------------------------------------------------------------------
+
+def cmd_run(args) -> int:
+    from wtf_tpu.dist.client import run_testcase_and_restore
+
+    opts = RunOptions(name=args.name, backend=args.backend,
+                      input=args.input, limit=args.limit, runs=args.runs,
+                      trace_path=args.trace_path,
+                      trace_type=args.trace_type, lanes=args.lanes,
+                      paths=_paths_from(args))
+    target = _lookup_target(args)
+    backend = _build_backend(target, opts.backend, opts.paths,
+                             opts.limit, opts.lanes)
+    target.init(backend)
+
+    inputs: List[Path] = (
+        sorted(p for p in opts.input.iterdir() if p.is_file())
+        if opts.input.is_dir() else [opts.input])
+    trace_dir = (opts.trace_path
+                 if opts.trace_path and len(inputs) > 1 else None)
+    if trace_dir:
+        trace_dir.mkdir(parents=True, exist_ok=True)
+
+    crashes = 0
+    for path in inputs:
+        data = path.read_bytes()
+        for _ in range(max(opts.runs, 1)):
+            if opts.trace_path:
+                trace_file = (trace_dir / f"{path.name}.trace"
+                              if trace_dir else opts.trace_path)
+                backend.set_trace_file(trace_file, opts.trace_type)
+            result, coverage = run_testcase_and_restore(
+                backend, target, data)
+            if isinstance(result, Crash):
+                crashes += 1
+            print(f"{path.name}: {result} (|cov| = {len(coverage)})")
+    backend.print_run_stats()
+    return 0 if crashes == 0 else 2
+
+
+def cmd_fuzz(args) -> int:
+    from wtf_tpu.dist.client import BatchClient, Client
+
+    opts = FuzzOptions(name=args.name, backend=args.backend,
+                       limit=args.limit, address=args.address,
+                       seed=args.seed, lanes=args.lanes,
+                       paths=_paths_from(args))
+    target = _lookup_target(args)
+    backend = _build_backend(target, opts.backend, opts.paths,
+                             opts.limit, opts.lanes)
+    node_cls = BatchClient if opts.backend == "tpu" else Client
+    node = node_cls(backend, target, opts.address)
+    served = node.run()
+    print(f"node served {served} testcases")
+    return 0
+
+
+def cmd_master(args) -> int:
+    from wtf_tpu.dist.server import Server
+    from wtf_tpu.fuzz.corpus import Corpus
+
+    opts = MasterOptions(name=args.name, address=args.address,
+                         runs=args.runs, max_len=args.max_len,
+                         seed=args.seed, paths=_paths_from(args))
+    target = _lookup_target(args)
+    rng = random.Random(opts.seed or None)
+    corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
+    server = Server(opts.address, _mutator_for(target, rng, opts.max_len),
+                    corpus, inputs_dir=opts.paths.inputs,
+                    crashes_dir=opts.paths.crashes, runs=opts.runs,
+                    max_len=opts.max_len, print_stats=True)
+    stats = server.run()
+    print(server.stats.line(len(server.coverage), len(corpus), 0))
+    return 0 if stats.crashes == 0 else 2
+
+
+def cmd_campaign(args) -> int:
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+
+    opts = CampaignOptions(name=args.name, backend=args.backend,
+                           limit=args.limit, runs=args.runs,
+                           max_len=args.max_len, seed=args.seed,
+                           lanes=args.lanes,
+                           stop_on_crash=args.stop_on_crash,
+                           paths=_paths_from(args))
+    target = _lookup_target(args)
+    backend = _build_backend(target, opts.backend, opts.paths,
+                             opts.limit, opts.lanes)
+    target.init(backend)
+    rng = random.Random(opts.seed or None)
+    corpus = (Corpus.load_dir(opts.paths.inputs, rng=rng,
+                              outputs_dir=opts.paths.outputs)
+              if opts.paths.inputs and Path(opts.paths.inputs).is_dir()
+              else Corpus(outputs_dir=opts.paths.outputs, rng=rng))
+    loop = FuzzLoop(backend, target, _mutator_for(target, rng, opts.max_len),
+                    corpus, crashes_dir=opts.paths.crashes)
+    stats = loop.fuzz(runs=opts.runs, print_stats=True,
+                      stop_on_crash=opts.stop_on_crash)
+    print(stats.line(len(corpus), loop._coverage()))
+    return 0 if stats.crashes == 0 else 2
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    driver = {
+        "run": cmd_run,
+        "fuzz": cmd_fuzz,
+        "master": cmd_master,
+        "campaign": cmd_campaign,
+    }[args.subcommand]
+    return driver(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

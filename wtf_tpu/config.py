@@ -1,0 +1,94 @@
+"""Per-subcommand options objects (the reference's Options_t layer,
+src/wtf/globals.h:1190-1385) + the targets/<name>/ path conventions
+(wtf.cc:48-68; README.md:27-33).
+
+The CLI (wtf_tpu/cli.py) parses argv into these; library users can build
+them directly — they are plain dataclasses with no argparse dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Optional
+
+BACKENDS = ("emu", "tpu")
+TRACE_TYPES = ("rip", "cov")
+DEFAULT_ADDRESS = "tcp://localhost:31337/"  # wtf.cc:79,369
+
+
+@dataclasses.dataclass
+class TargetPaths:
+    """targets/<t>/{inputs,outputs,crashes,state} conventions."""
+
+    target: Optional[Path] = None
+    inputs: Optional[Path] = None
+    outputs: Optional[Path] = None
+    crashes: Optional[Path] = None
+    state: Optional[Path] = None
+
+    def resolve(self) -> "TargetPaths":
+        """Default unset dirs from the target root (wtf.cc:48-68)."""
+        if self.target is not None:
+            root = Path(self.target)
+            self.inputs = self.inputs or root / "inputs"
+            self.outputs = self.outputs or root / "outputs"
+            self.crashes = self.crashes or root / "crashes"
+            self.state = self.state or root / "state"
+        return self
+
+
+@dataclasses.dataclass
+class RunOptions:
+    """`wtf run` options (globals.h Run*Options role)."""
+
+    name: str = ""
+    backend: str = "emu"
+    input: Optional[Path] = None
+    limit: int = 0
+    runs: int = 1
+    trace_path: Optional[Path] = None
+    trace_type: str = "rip"
+    lanes: int = 4
+    paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
+
+
+@dataclasses.dataclass
+class FuzzOptions:
+    """`wtf fuzz` node options."""
+
+    name: str = ""
+    backend: str = "tpu"
+    limit: int = 0
+    address: str = DEFAULT_ADDRESS
+    seed: int = 0
+    lanes: int = 64
+    paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
+
+
+@dataclasses.dataclass
+class MasterOptions:
+    """`wtf master` options."""
+
+    name: str = ""
+    address: str = DEFAULT_ADDRESS
+    runs: int = 0
+    max_len: int = 1024 * 1024
+    seed: int = 0
+    paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
+
+
+@dataclasses.dataclass
+class CampaignOptions:
+    """`wtf campaign` (single-process master+node fused loop — the batch
+    framework's native mode; no reference equivalent)."""
+
+    name: str = ""
+    backend: str = "tpu"
+    limit: int = 0
+    runs: int = 0
+    max_len: int = 1024 * 1024
+    seed: int = 0
+    lanes: int = 64
+    stop_on_crash: bool = False
+    paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)

@@ -1,0 +1,116 @@
+"""Fuzz node: dial the master, execute testcases, report coverage+result.
+
+Reference `Client_t` (src/wtf/client.cc): Run (:210-263) = Target.Init once,
+Dial, then loop { Receive testcase -> RunTestcaseAndRestore -> SendResult }.
+`run_testcase_and_restore` below is the canonical per-testcase sequence
+(client.cc:88-180): InsertTestcase -> Run -> (Timedout? revoke coverage)
+-> Target.Restore -> Backend.Restore.
+
+Two node shapes:
+
+  Client      - one connection, one testcase at a time (any Backend; the
+                reference's process-per-core model)
+  BatchClient - one *lane batch* per round against a TpuBackend: opens
+                n_lanes connections so the master remains completely
+                unmodified (the north-star property — the master cannot
+                tell a TPU pod from n_lanes ordinary clients), collects one
+                testcase per connection, runs them as one device batch, and
+                replies on each connection with that lane's coverage delta.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional, Set, Tuple
+
+from wtf_tpu.core.results import TestcaseResult, Timedout
+from wtf_tpu.dist import wire
+from wtf_tpu.utils.human import number_to_human
+
+
+def run_testcase_and_restore(backend, target, data: bytes,
+                             ) -> Tuple[TestcaseResult, Set[int]]:
+    """The canonical sequence (client.cc:88-180)."""
+    target.insert_testcase(backend, data)
+    result = backend.run()
+    if isinstance(result, Timedout):
+        backend.revoke_last_new_coverage()  # client.cc:122-125
+    coverage = backend.last_new_coverage()
+    target.restore()
+    backend.restore()
+    return result, coverage
+
+
+class Client:
+    """Single-slot node (reference shape)."""
+
+    def __init__(self, backend, target, address: str):
+        self.backend = backend
+        self.target = target
+        self.address = address
+        self.runs = 0
+
+    def run(self, max_runs: int = 0) -> int:
+        """Serve until the master closes (or max_runs served)."""
+        self.target.init(self.backend)
+        sock = wire.dial(self.address, retry_for=10.0)
+        try:
+            while max_runs == 0 or self.runs < max_runs:
+                testcase = wire.recv_msg(sock)
+                if testcase is None:
+                    break  # master gone: node exits (client.cc:228-231)
+                result, coverage = run_testcase_and_restore(
+                    self.backend, self.target, testcase)
+                wire.send_msg(
+                    sock, wire.encode_result(testcase, coverage, result))
+                self.runs += 1
+        finally:
+            sock.close()
+        return self.runs
+
+
+class BatchClient:
+    """TPU node: n_lanes master connections, one device batch per round."""
+
+    def __init__(self, backend, target, address: str):
+        self.backend = backend
+        self.target = target
+        self.address = address
+        self.rounds = 0
+        self.runs = 0
+
+    def run(self, max_rounds: int = 0) -> int:
+        self.target.init(self.backend)
+        n = self.backend.n_lanes
+        socks: List[socket.socket] = [
+            wire.dial(self.address, retry_for=10.0) for _ in range(n)]
+        try:
+            while max_rounds == 0 or self.rounds < max_rounds:
+                batch: List[Optional[bytes]] = []
+                live: List[socket.socket] = []
+                for sock in socks:
+                    tc = wire.recv_msg(sock)
+                    if tc is not None:
+                        batch.append(tc)
+                        live.append(sock)
+                if not batch:
+                    break
+                socks = live
+                results = self.backend.run_batch(batch, self.target)
+                for lane, (sock, data, result) in enumerate(
+                        zip(socks, batch, results)):
+                    coverage = self.backend.lane_coverage(lane)
+                    if isinstance(result, Timedout):
+                        coverage = set()  # revoked (client.cc:122-125)
+                    elif not self.backend.lane_found_new_coverage(lane):
+                        coverage = set()  # nothing new to report
+                    wire.send_msg(
+                        sock, wire.encode_result(data, coverage, result))
+                    self.runs += 1
+                self.target.restore()
+                self.backend.restore()
+                self.rounds += 1
+        finally:
+            for sock in socks:
+                sock.close()
+        return self.runs

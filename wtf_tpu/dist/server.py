@@ -1,0 +1,197 @@
+"""Master node: testcase generation, coverage aggregation, corpus, crashes.
+
+Reference `Server_t` (src/wtf/server.h): a single-threaded select() reactor
+(Run server.h:361-598) in lock-step request/response with each client
+(state machine server.h:249-255).  Semantics preserved here:
+
+  - seed paths: inputs/ files are streamed to clients biggest-first before
+    any mutation happens (server.h:399-414, :629-706)
+  - GetTestcase: corpus-file replay first, else mutate (server.h:629-714)
+  - HandleNewResult: merge client coverage into the global set; if it grew,
+    feed the mutator cross-over and save the testcase into outputs/
+    (server.h:785-886); named crashes saved under crashes/ (:861-877)
+  - run budget: stop once `mutations >= runs` and no seed paths remain
+    (server.h:552-556); `runs=0` = minset mode — only replay the seeds,
+    outputs/ ends up holding the coverage-minimal subset (README.md:81-92)
+  - elasticity: clients may join/leave anytime; a dropped fd is just
+    removed from the reactor (server.h:534-544,605-623)
+"""
+
+from __future__ import annotations
+
+import select
+import socket
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Set
+
+from wtf_tpu.core.results import Cr3Change, Crash, Timedout
+from wtf_tpu.dist import wire
+from wtf_tpu.fuzz.corpus import Corpus
+from wtf_tpu.fuzz.mutator import Mutator
+from wtf_tpu.utils.human import number_to_human, seconds_to_human
+
+
+class ServerStats:
+    """Status-line counters (reference ServerStats_t, server.h:24-240)."""
+
+    def __init__(self):
+        self.testcases = 0
+        self.crashes = 0
+        self.timeouts = 0
+        self.cr3s = 0
+        self.last_cov = time.time()
+        self.start = time.time()
+        self.last_print = 0.0
+
+    def line(self, cov: int, corpus_len: int, clients: int) -> str:
+        dt = time.time() - self.start
+        execs = self.testcases / dt if dt > 0 else 0.0
+        return (f"#{number_to_human(self.testcases)} cov: {cov} "
+                f"corp: {corpus_len} exec/s: {execs:.1f} "
+                f"nodes: {clients} lastcov: "
+                f"{seconds_to_human(time.time() - self.last_cov)} "
+                f"crash: {self.crashes} timeout: {self.timeouts} "
+                f"cr3: {self.cr3s} uptime: {seconds_to_human(dt)}")
+
+
+class Server:
+    def __init__(
+        self,
+        address: str,
+        mutator: Mutator,
+        corpus: Corpus,
+        inputs_dir: Optional[Path] = None,
+        crashes_dir: Optional[Path] = None,
+        runs: int = 0,
+        max_len: int = 1024 * 1024,
+        stats_every: float = 10.0,
+        print_stats: bool = False,
+    ):
+        self.address = address
+        self.mutator = mutator
+        self.corpus = corpus
+        self.crashes_dir = Path(crashes_dir) if crashes_dir else None
+        if self.crashes_dir:
+            self.crashes_dir.mkdir(parents=True, exist_ok=True)
+        self.runs = runs
+        self.max_len = max_len
+        self.stats = ServerStats()
+        self.stats_every = stats_every
+        self.print_stats = print_stats
+        # seed paths: biggest first (server.h:399-414)
+        self.paths: List[bytes] = []
+        if inputs_dir and Path(inputs_dir).is_dir():
+            files = sorted((p for p in Path(inputs_dir).iterdir()
+                            if p.is_file()),
+                           key=lambda p: p.stat().st_size, reverse=True)
+            self.paths = [p.read_bytes() for p in files]
+        self.coverage: Set[int] = set()
+        self.mutations = 0
+        self.crash_names: Set[str] = set()
+        self._listener: Optional[socket.socket] = None
+        self._clients: Dict[socket.socket, bool] = {}  # sock -> sent?
+
+    # -- testcase generation (server.h:629-714) ----------------------------
+    def get_testcase(self) -> Optional[bytes]:
+        if self.paths:
+            return self.paths.pop(0)[:self.max_len]
+        if self.runs and self.mutations >= self.runs:
+            return None
+        if self.runs == 0:
+            return None  # minset mode: seeds only (server.h:552-556)
+        self.mutations += 1
+        return self.mutator.get_new_testcase(self.corpus)[:self.max_len]
+
+    def done(self) -> bool:
+        outstanding = any(self._clients.values())
+        if outstanding or self.paths:
+            return False
+        if self.runs == 0:
+            return True
+        return self.mutations >= self.runs
+
+    # -- result handling (server.h:785-886) --------------------------------
+    def handle_result(self, body: bytes) -> None:
+        testcase, coverage, result = wire.decode_result(body)
+        self.stats.testcases += 1
+        new = coverage - self.coverage
+        if new:
+            self.coverage |= new
+            self.stats.last_cov = time.time()
+            self.mutator.on_new_coverage(testcase)
+            self.corpus.add(testcase)
+        if isinstance(result, Crash):
+            self.stats.crashes += 1
+            if result.name:
+                self.crash_names.add(result.name)
+                if self.crashes_dir:
+                    (self.crashes_dir / result.name).write_bytes(testcase)
+        elif isinstance(result, Timedout):
+            self.stats.timeouts += 1
+        elif isinstance(result, Cr3Change):
+            self.stats.cr3s += 1
+
+    # -- reactor (server.h:361-598) ----------------------------------------
+    def run(self, max_seconds: Optional[float] = None) -> ServerStats:
+        self._listener = wire.listen(self.address)
+        deadline = time.time() + max_seconds if max_seconds else None
+        try:
+            while True:
+                if self.done():
+                    break
+                if deadline and time.time() > deadline:
+                    break
+                rlist = [self._listener] + list(self._clients)
+                # lock-step: only clients we haven't fed yet are writable
+                wlist = [c for c, sent in self._clients.items() if not sent]
+                ready_r, ready_w, _ = select.select(rlist, wlist, [], 0.5)
+                for sock in ready_w:
+                    self._feed(sock)
+                for sock in ready_r:
+                    if sock is self._listener:
+                        conn, _ = self._listener.accept()
+                        self._clients[conn] = False
+                        continue
+                    self._on_readable(sock)
+                self._maybe_print()
+        finally:
+            for sock in list(self._clients):
+                sock.close()
+            self._clients.clear()
+            self._listener.close()
+            self._listener = None
+        return self.stats
+
+    def _feed(self, sock: socket.socket) -> None:
+        testcase = self.get_testcase()
+        if testcase is None:
+            return  # budget exhausted; leave client idle until done()
+        try:
+            wire.send_msg(sock, testcase)
+            self._clients[sock] = True
+        except OSError:
+            self._drop(sock)
+
+    def _on_readable(self, sock: socket.socket) -> None:
+        try:
+            body = wire.recv_msg(sock)
+        except (OSError, ValueError):
+            body = None
+        if body is None:
+            self._drop(sock)
+            return
+        self.handle_result(body)
+        self._clients[sock] = False
+
+    def _drop(self, sock: socket.socket) -> None:
+        self._clients.pop(sock, None)
+        sock.close()
+
+    def _maybe_print(self) -> None:
+        now = time.time()
+        if (self.print_stats
+                and now - self.stats.last_print >= self.stats_every):
+            self.stats.last_print = now
+            print(self.stats.line(len(self.coverage), len(self.corpus),
+                                  len(self._clients)))

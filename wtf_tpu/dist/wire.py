@@ -1,0 +1,168 @@
+"""Wire protocol: address scheme + length-prefixed framed messages.
+
+Reference surface (src/wtf/socket.{h,cc}): `tcp://host:port/` and
+`unix:///path` address strings (socket.cc:70-225), Listen/Dial with
+TCP_NODELAY (socket.cc:227-308), u32-length-prefixed messages
+(Send socket.cc:310-323, Receive :325-358).  The reference serializes with
+yas binary archives; SURVEY.md §2.6 notes the wire format is an internal
+detail, not a contract — this module uses an explicit little-endian struct
+layout instead:
+
+  server -> client:  the raw testcase bytes (server.h:720-736 sends just
+                     the testcase string)
+  client -> server:  u32 testcase_len | testcase
+                     u32 n_cov | n_cov * u64 coverage addresses
+                     u8 result kind (0 ok, 1 timedout, 2 cr3change, 3 crash)
+                     u16 name_len | crash name utf-8
+                     (client.cc:187-200 / server.h:771-779 message shape)
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional, Set, Tuple
+
+from wtf_tpu.core.results import (
+    Cr3Change, Crash, Ok, TestcaseResult, Timedout,
+)
+
+MAX_MSG = 64 * 1024 * 1024  # sanity bound on a frame
+
+
+# ---------------------------------------------------------------------------
+# addresses
+# ---------------------------------------------------------------------------
+
+def parse_address(address: str):
+    """'tcp://host:port/' -> (AF_INET, (host, port));
+    'unix:///path' -> (AF_UNIX, path).  (socket.cc:70-225)"""
+    if address.startswith("tcp://"):
+        rest = address[len("tcp://"):].rstrip("/")
+        host, _, port = rest.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad tcp address {address!r}")
+        return socket.AF_INET, (host, int(port))
+    if address.startswith("unix://"):
+        path = address[len("unix://"):]
+        if not path:
+            raise ValueError(f"bad unix address {address!r}")
+        return socket.AF_UNIX, path
+    raise ValueError(f"unsupported address scheme {address!r}")
+
+
+def listen(address: str, backlog: int = 64) -> socket.socket:
+    family, addr = parse_address(address)
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    if family == socket.AF_INET:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.bind(addr)
+    sock.listen(backlog)
+    return sock
+
+
+def dial(address: str, timeout: Optional[float] = None,
+         retry_for: float = 0.0) -> socket.socket:
+    """Connect to a master.  `retry_for` seconds of connect retries cover
+    the node-starts-before-master race (the reference leaves this to the
+    operator; nodes here are commonly spawned together with the master)."""
+    import time
+
+    family, addr = parse_address(address)
+    deadline = time.monotonic() + retry_for
+    while True:
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        if timeout is not None:
+            sock.settimeout(timeout)
+        try:
+            sock.connect(addr)
+        except (ConnectionRefusedError, FileNotFoundError):
+            sock.close()
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
+            continue
+        if family == socket.AF_INET:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+
+# ---------------------------------------------------------------------------
+# framing (u32 length prefix, socket.cc:310-358)
+# ---------------------------------------------------------------------------
+
+def send_msg(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None  # peer closed
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Optional[bytes]:
+    hdr = recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack("<I", hdr)
+    if length > MAX_MSG:
+        raise ValueError(f"oversized frame ({length} bytes)")
+    return recv_exact(sock, length)
+
+
+# ---------------------------------------------------------------------------
+# result message body
+# ---------------------------------------------------------------------------
+
+_KIND = {Ok: 0, Timedout: 1, Cr3Change: 2, Crash: 3}
+
+
+def encode_result(testcase: bytes, coverage: Set[int],
+                  result: TestcaseResult) -> bytes:
+    kind = _KIND[type(result)]
+    name = (result.name or "").encode() if isinstance(result, Crash) else b""
+    parts = [
+        struct.pack("<I", len(testcase)), testcase,
+        struct.pack("<I", len(coverage)),
+        struct.pack(f"<{len(coverage)}Q", *sorted(coverage)),
+        struct.pack("<B", kind),
+        struct.pack("<H", len(name)), name,
+    ]
+    return b"".join(parts)
+
+
+def decode_result(body: bytes) -> Tuple[bytes, Set[int], TestcaseResult]:
+    off = 0
+
+    def take(fmt):
+        nonlocal off
+        size = struct.calcsize(fmt)
+        vals = struct.unpack_from(fmt, body, off)
+        off += size
+        return vals
+
+    (tc_len,) = take("<I")
+    testcase = body[off:off + tc_len]
+    off += tc_len
+    (n_cov,) = take("<I")
+    coverage = set(take(f"<{n_cov}Q")) if n_cov else set()
+    (kind,) = take("<B")
+    (name_len,) = take("<H")
+    name = body[off:off + name_len].decode()
+    off += name_len
+    result: TestcaseResult
+    if kind == 0:
+        result = Ok()
+    elif kind == 1:
+        result = Timedout()
+    elif kind == 2:
+        result = Cr3Change()
+    else:
+        result = Crash(name or None)
+    return testcase, coverage, result

@@ -67,7 +67,7 @@ class ByteMutator(Mutator):
 
     def _mutate_once(self, data: bytearray) -> None:
         rng = self.rng
-        op = rng.randrange(7)
+        op = rng.randrange(8)
         if op == 0 and len(data) > 1:          # erase range
             start = rng.randrange(len(data))
             count = rng.randint(1, max(1, len(data) - start))
@@ -89,6 +89,12 @@ class ByteMutator(Mutator):
             del data[self.max_len:]
         elif op == 5 and data:                 # change ASCII integer
             self._change_ascii_int(data)
+        elif op == 6 and data:                 # interesting byte (libFuzzer
+            pos = rng.randrange(len(data))     #  InterestingValues role)
+            if rng.randrange(2):
+                data[pos] = rng.choice((0x00, 0x01, 0x7F, 0x80, 0xFF, 0x20))
+            else:
+                data[pos] = 0x20 + rng.randrange(95)  # printable ascii
         else:                                  # cross-over
             other = self._cross
             if other and data:

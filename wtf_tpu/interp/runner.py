@@ -525,9 +525,15 @@ class Runner:
                         f"breakpoint @ {view.get_rip(lane):#x} with no handler")
                     view.set_status(lane, StatusCode.CRASH)
                     continue
+                rip_before = view.get_rip(lane)
                 bp_handler(self, view, lane)
                 if view.get_status(lane) == StatusCode.BREAKPOINT:
-                    view.r["bp_skip"][lane] = np.int32(1)
+                    # resume: suppress the bp for one step ONLY if the
+                    # handler left rip in place; a redirected rip must hit
+                    # any breakpoint armed at the new address (the emu
+                    # backend's skip_rip-clearing semantics, emu.py:66-67)
+                    if view.get_rip(lane) == rip_before:
+                        view.r["bp_skip"][lane] = np.int32(1)
                     view.set_status(lane, StatusCode.RUNNING)
             self.push(view)
             tab = self.cache.device()
@@ -539,6 +545,32 @@ class Runner:
         SURVEY.md §5.4)."""
         self.machine = machine_restore(self.machine, self.template)
         self.lane_errors.clear()
+        # per-testcase SMC thrash window: a rip legitimately rewritten many
+        # times within ONE run falls back to the oracle, but the count must
+        # not accumulate across the campaign (fresh-run behavior parity)
+        self._smc_updates.clear()
 
     def statuses(self) -> np.ndarray:
         return np.asarray(self.machine.status)
+
+
+def warm_decode_cache(runner: Runner, target, payload: bytes,
+                      limit: int = 100_000) -> int:
+    """Populate the runner's uop table by running `payload` once on the
+    host EmuCpu oracle and decoding every reached rip — pure host work, no
+    device compile (used by entry points that must budget XLA compiles).
+    Returns the number of rips decoded."""
+    from wtf_tpu.backend.emu import EmuBackend
+
+    eb = EmuBackend(runner.snapshot, limit=limit)
+    eb.initialize()
+    target.init(eb)
+    target.insert_testcase(eb, payload)
+    eb.run()
+    view = runner.view()
+    n = 0
+    for rip in sorted(eb.last_new_coverage()):
+        if rip not in runner.cache.index:
+            runner._decode_at(view, 0, rip)
+            n += 1
+    return n

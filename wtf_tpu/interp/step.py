@@ -463,13 +463,17 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
                  jnp.where(is_sse, opsize, srcsize))))
     l1_size = jnp.where(is_popf | is_ret | is_leave, 8, l1_size)
 
+    # store-only destinations (MOV/SETCC/POP write [mem] without reading it)
+    # must NOT issue a dst-read load: their fault is the *store* fault, so
+    # crash names report write access like the oracle's translate(write=True)
+    store_only = is_(U.OPC_MOV) | is_(U.OPC_SETCC) | is_pop
     l2_need = live & ~unsupported & ~rep_skip & (
-        ((dk == U.K_MEM) & ~is_sse) | s_cmps)
+        ((dk == U.K_MEM) & ~is_sse & ~store_only) | s_cmps)
     l2_addr = jnp.where(s_cmps, rdi, ea)
     l2_size = opsize
 
-    b1, fault1, _, _ = _load16(image, overlay, st.cr3, l1_addr, l1_size, l1_need)
-    b2, fault2, _, _ = _load16(image, overlay, st.cr3, l2_addr, l2_size, l2_need)
+    b1, fault1, l1t0, _ = _load16(image, overlay, st.cr3, l1_addr, l1_size, l1_need)
+    b2, fault2, l2t0, _ = _load16(image, overlay, st.cr3, l2_addr, l2_size, l2_need)
     l1_lo, l1_hi = _pack_u64(b1, 0), _pack_u64(b1, 8)
     l2_lo = _pack_u64(b2, 0)
 
@@ -1189,9 +1193,18 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         default=jnp.int32(int(S.RUNNING)))
     new_status = jnp.where(enabled, status_chain, st.status)
 
+    # faulting address: when the access's first page translates but the
+    # access straddles into a bad page, the faulting byte is at the next
+    # page boundary (the oracle's per-page walk reports it there)
+    def _fault_at(addr, first_ok):
+        return jnp.where(first_ok, (addr & ~_u(0xFFF)) + _u(0x1000), addr)
+
+    st_first_ok = ts0.ok & ts0.writable
     new_fault_gva = jnp.where(
         enabled & page_fault,
-        jnp.where(fault1, l1_addr, jnp.where(fault2, l2_addr, st_addr)),
+        jnp.where(fault1, _fault_at(l1_addr, l1t0.ok),
+                  jnp.where(fault2, _fault_at(l2_addr, l2t0.ok),
+                            _fault_at(st_addr, st_first_ok))),
         jnp.where(enabled & is_crash, rip, st.fault_gva))
     new_fault_write = jnp.where(
         enabled & page_fault & ~fault1 & ~fault2, jnp.int32(1),
