@@ -1,0 +1,126 @@
+"""kdmp parser tests: write_kdmp fixtures round-trip through both the
+native C++ parser and the pure-Python fallback, and a .dmp-backed snapshot
+actually fuzzes (VERDICT round-2 item 4's done criterion)."""
+
+import struct
+
+import pytest
+
+from wtf_tpu.core.cpustate import CpuState
+from wtf_tpu.snapshot import kdmp
+from wtf_tpu.snapshot.loader import load_snapshot
+from wtf_tpu.harness import demo_tlv
+
+
+def _pages():
+    # non-contiguous PFNs -> multiple runs / bitmap holes
+    return {
+        0x10: bytes([0x11]) * 0x1000,
+        0x11: bytes([0x22]) * 0x1000,
+        0x40: bytes([0x33]) * 0x1000,
+        0x1000: bytes(range(256)) * 16,
+    }
+
+
+@pytest.mark.parametrize("dump_type", ["full", "bmp"])
+def test_roundtrip_python(tmp_path, dump_type, monkeypatch):
+    path = tmp_path / "mem.dmp"
+    cpu = CpuState()
+    cpu.rip = 0x1337
+    cpu.rax = 0xAABBCCDD
+    cpu.rflags = 0x246
+    kdmp.write_kdmp(path, _pages(), dump_type=dump_type,
+                    dtb=0x1AD000, cpu=cpu, bugcheck_code=0xDEADDEAD)
+    # force the pure-python path
+    monkeypatch.setattr(kdmp, "_parse_native", lambda p: None)
+    info = kdmp.parse_kdmp_info(path)
+    assert info.dtb == 0x1AD000
+    assert info.bugcheck_code == 0xDEADDEAD
+    assert info.n_pages == 4
+    regs = info.context_registers()
+    assert regs["rip"] == 0x1337
+    assert regs["rax"] == 0xAABBCCDD
+    assert regs["rflags"] == 0x246
+    pages = kdmp.parse_kdmp(path)
+    assert pages.keys() == _pages().keys()
+    for pfn, data in _pages().items():
+        assert pages[pfn] == data, hex(pfn)
+
+
+@pytest.mark.parametrize("dump_type", ["full", "bmp"])
+def test_roundtrip_native(tmp_path, dump_type):
+    lib = kdmp._native_lib()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    path = tmp_path / "mem.dmp"
+    kdmp.write_kdmp(path, _pages(), dump_type=dump_type, dtb=0x1AD000)
+    info, index = kdmp._parse_native(path)
+    assert info.dump_type == (1 if dump_type == "full" else 5)
+    assert info.dtb == 0x1AD000
+    assert {pfn for pfn, _ in index} == _pages().keys()
+    pages = kdmp.parse_kdmp(path)
+    for pfn, data in _pages().items():
+        assert pages[pfn] == data, hex(pfn)
+
+
+def test_native_and_python_agree(tmp_path):
+    lib = kdmp._native_lib()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    path = tmp_path / "mem.dmp"
+    kdmp.write_kdmp(path, _pages(), dump_type="bmp", dtb=0x7777000)
+    native_info, native_index = kdmp._parse_native(path)
+    with open(path, "rb") as f:
+        py_info, py_index = kdmp._parse_python(f.read())
+    assert native_index == py_index
+    assert native_info.dtb == py_info.dtb
+    assert native_info.context_raw == py_info.context_raw
+
+
+def test_bad_signature(tmp_path):
+    path = tmp_path / "mem.dmp"
+    path.write_bytes(b"NOPE" * 0x1000)
+    with pytest.raises(kdmp.KdmpError):
+        kdmp.parse_kdmp(path)
+
+
+def test_kernel_dump_rejected(tmp_path):
+    path = tmp_path / "mem.dmp"
+    header = bytearray(0x3000)
+    struct.pack_into("<II", header, 0, kdmp.SIG_PAGE, kdmp.SIG_DU64)
+    struct.pack_into("<I", header, 0xF98, kdmp.KERNEL_DUMP)
+    path.write_bytes(bytes(header))
+    with pytest.raises(kdmp.KdmpError, match="partial kernel"):
+        kdmp.parse_kdmp(path)
+
+
+def test_dmp_snapshot_fuzzes(tmp_path):
+    """A demo_tlv snapshot exported as mem.dmp + regs.json loads through
+    load_snapshot and reproduces the planted crash end-to-end."""
+    from wtf_tpu.backend import create_backend
+    from wtf_tpu.core.results import Crash, Ok
+    from wtf_tpu.snapshot.loader import dump_cpu_state_json
+
+    import numpy as np
+
+    snap = demo_tlv.build_snapshot()
+    state = tmp_path / "state"
+    state.mkdir()
+    # export guest memory as a BMP crash dump
+    table = np.asarray(snap.physmem.image.frame_table)
+    page_data = np.asarray(snap.physmem.image.pages)
+    pages = {int(pfn): bytes(page_data[int(table[pfn])].tobytes())
+             for pfn in np.nonzero(table)[0]}
+    kdmp.write_kdmp(state / "mem.dmp", pages, dump_type="bmp",
+                    dtb=snap.cpu.cr3, cpu=snap.cpu)
+    (state / "regs.json").write_text(dump_cpu_state_json(snap.cpu))
+
+    loaded = load_snapshot(state)
+    assert loaded.cpu.rip == snap.cpu.rip
+    backend = create_backend("emu", loaded)
+    backend.initialize()
+    demo_tlv.TARGET.init(backend)
+    results = backend.run_batch(
+        [b"\x01\x02AB", bytes([3, 64]) + b"A" * 64], demo_tlv.TARGET)
+    assert isinstance(results[0], Ok)
+    assert isinstance(results[1], Crash)

@@ -1,0 +1,39 @@
+"""Native (C++) components and their build-on-demand loader.
+
+SURVEY.md §2.6 marks the snapshot parser (and later: hot host-plane pieces)
+as native in the rebuild.  Sources live next to this file; binaries build
+into `_build/` on first use with the in-image toolchain (g++).  Every
+native component has a pure-Python fallback so the framework still works
+without a compiler — the native path is the fast path, not a hard
+dependency.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+_DIR = Path(__file__).parent
+_BUILD = _DIR / "_build"
+
+
+def build_library(name: str, sources: list[str],
+                  extra_flags: Optional[list[str]] = None) -> Optional[Path]:
+    """Compile `sources` (relative to native/) into _build/lib<name>.so;
+    returns the path, a cached build, or None when no compiler exists.
+    Rebuilds when any source is newer than the binary."""
+    out = _BUILD / f"lib{name}.so"
+    srcs = [_DIR / s for s in sources]
+    if out.exists() and all(
+            s.stat().st_mtime <= out.stat().st_mtime for s in srcs):
+        return out
+    _BUILD.mkdir(exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           *(extra_flags or []),
+           *[str(s) for s in srcs], "-o", str(out)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
