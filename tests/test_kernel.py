@@ -1,0 +1,155 @@
+"""Kernel-mode target + crash-detection harness tests.
+
+VERDICT round-2 item 5's done criteria: an end-to-end fuzz finds the
+kernel bug, and crash names distinguish read/write/exec.
+"""
+
+import random
+import struct
+
+import pytest
+
+from wtf_tpu.backend import create_backend
+from wtf_tpu.core import nt
+from wtf_tpu.core.results import Crash, Ok
+from wtf_tpu.fuzz.corpus import Corpus
+from wtf_tpu.fuzz.loop import FuzzLoop
+from wtf_tpu.fuzz.mutator import ByteMutator
+from wtf_tpu.harness import crash_detection, demo_kernel as dk
+
+
+BENIGN = b"\x01" + bytes([1, 2, 3, 4])
+BUGCHECK = b"\x02" + struct.pack("<IQ", 0xDEADBEEF, 0x41) + b"pad"
+OOB_WRITE = b"\x03" + b"A" * 200
+WILD_JUMP = b"\x04" + struct.pack("<Q", 0xDEAD0000) + b"x"
+
+
+def make_backend(name, **kw):
+    backend = create_backend(name, dk.build_snapshot(), limit=100_000, **kw)
+    backend.initialize()
+    dk.TARGET.init(backend)
+    return backend
+
+
+def test_kernel_crash_classes_emu():
+    backend = make_backend("emu")
+    results = backend.run_batch(
+        [BENIGN, BUGCHECK, OOB_WRITE, WILD_JUMP, b""], dk.TARGET)
+    assert isinstance(results[0], Ok)
+    assert results[1].name == "crash-bugcheck-0xdeadbeef-0x41"
+    assert results[2].name == f"crash-write-{dk.KBUF_PAGE + 0x1000:#x}"
+    assert results[3].name == "crash-execute-0xdead0000"
+    assert isinstance(results[4], Ok)
+
+
+def test_kernel_backends_agree():
+    """syscall/swapgs/stack-switch/sysret + all crash classes must match
+    between the device interpreter and the oracle, name for name."""
+    cases = [BENIGN, BUGCHECK, OOB_WRITE, WILD_JUMP, b"", b"\x03\x41",
+             b"\x02short", b"\x01" + bytes(range(250))]
+    emu = make_backend("emu")
+    tpu = make_backend("tpu", n_lanes=8)
+    r_emu = emu.run_batch(cases, dk.TARGET)
+    r_tpu = tpu.run_batch(cases, dk.TARGET)
+    for i, (a, b) in enumerate(zip(r_emu, r_tpu)):
+        assert type(a) is type(b), f"case {i}: emu={a} tpu={b}"
+        if isinstance(a, Crash):
+            assert a.name == b.name, f"case {i}: emu={a} tpu={b}"
+    # the kernel path must run natively on device, not via oracle fallback
+    assert tpu.runner.stats["fallbacks"] == 0
+
+
+def test_kernel_determinism_across_restore():
+    backend = make_backend("tpu", n_lanes=4)
+    r1 = backend.run_batch([OOB_WRITE, BENIGN], dk.TARGET)
+    dk.TARGET.restore()
+    backend.restore()
+    r2 = backend.run_batch([OOB_WRITE, BENIGN], dk.TARGET)
+    assert r1[0].name == r2[0].name
+    assert type(r1[1]) is type(r2[1])
+
+
+# seed verified to reach the cmd-3 kernel OOB write within the cap
+_FUZZ_SEED = {"emu": 21, "tpu": 21}
+
+
+@pytest.mark.parametrize("backend_name", ["emu", "tpu"])
+def test_kernel_fuzz_finds_bug(backend_name):
+    backend = make_backend(backend_name, **(
+        {"n_lanes": 16} if backend_name == "tpu" else {}))
+    rng = random.Random(_FUZZ_SEED[backend_name])
+    corpus = Corpus(rng=rng)
+    corpus.add(b"\x01\x10\x20")
+    corpus.add(b"\x03\x41")
+    loop = FuzzLoop(backend, dk.TARGET, ByteMutator(rng, max_len=64),
+                    corpus, batch_size=16 if backend_name == "tpu" else 8)
+    stats = loop.fuzz(runs=30_000, stop_on_crash=True)
+    assert stats.crashes >= 1, (
+        f"no kernel crash after {stats.testcases} testcases "
+        f"(corpus={len(corpus)})")
+    assert any(n.startswith("crash-") for n in loop.crash_names)
+
+
+# ---------------------------------------------------------------------------
+# user-mode exception-dispatch hook (EXCEPTION_RECORD parsing)
+# ---------------------------------------------------------------------------
+
+def _dispatch_snapshot(record: bytes):
+    from wtf_tpu.snapshot.loader import Snapshot
+    from wtf_tpu.snapshot.synthetic import SyntheticSnapshotBuilder
+
+    DISPATCH = 0x1500_0000
+    RECORD = 0x1600_0000
+    b = SyntheticSnapshotBuilder()
+    b.write(DISPATCH, b"\x90\xf4")  # nop ; hlt (hook fires pre-execution)
+    b.write(RECORD, record)
+    b.map(0x7FFF0000, 0x2000)
+    pages, cpu = b.build(rip=DISPATCH, rsp=0x7FFF1000)
+    cpu.rcx = RECORD
+    return Snapshot.from_pages(pages, cpu, symbols={
+        crash_detection.SYM_DISPATCH_EXCEPTION: DISPATCH,
+    })
+
+
+def _record(code: int, params=()) -> bytes:
+    raw = bytearray(nt.ExceptionRecord.SIZE)
+    struct.pack_into("<II", raw, 0, code, 0)
+    struct.pack_into("<QQ", raw, 8, 0, 0x1234_5678)
+    struct.pack_into("<I", raw, 0x18, len(params))
+    for i, p in enumerate(params):
+        struct.pack_into("<Q", raw, 0x20 + i * 8, p)
+    return bytes(raw)
+
+
+@pytest.mark.parametrize("record,expect", [
+    (_record(nt.EXCEPTION_ACCESS_VIOLATION, (1, 0xDEADBEEF)),
+     "crash-write-0xdeadbeef"),
+    (_record(nt.EXCEPTION_ACCESS_VIOLATION, (0, 0xCAFE)),
+     "crash-read-0xcafe"),
+    (_record(nt.EXCEPTION_ACCESS_VIOLATION, (8, 0x41414141)),
+     "crash-execute-0x41414141"),
+    (_record(nt.EXCEPTION_STACK_BUFFER_OVERRUN),
+     "crash-stack-buffer-overrun-0x12345678"),
+    (_record(nt.EXCEPTION_INT_DIVIDE_BY_ZERO),
+     "crash-divide-by-zero-0x12345678"),
+])
+def test_exception_record_refinement(record, expect):
+    backend = create_backend("emu", _dispatch_snapshot(record))
+    backend.initialize()
+    crash_detection.setup_usermode_crash_detection(backend)
+    result = backend.run()
+    assert isinstance(result, Crash), result
+    assert result.name == expect
+
+
+def test_exception_dispatch_filters_dbg_print():
+    """DbgPrint/C++ exceptions are not crashes: the hook lets the guest's
+    own dispatch run (here: falls through to the hlt)."""
+    record = _record(nt.DBG_PRINTEXCEPTION_C)
+    backend = create_backend("emu", _dispatch_snapshot(record))
+    backend.initialize()
+    crash_detection.setup_usermode_crash_detection(backend)
+    result = backend.run()
+    # passed through the hook; the stub guest then executes nop+hlt
+    assert isinstance(result, Crash)
+    assert result.name.startswith("crash-int-")

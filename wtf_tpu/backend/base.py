@@ -95,6 +95,14 @@ class Backend(abc.ABC):
             return self.get_rip()
         self.set_rip(value)
 
+    @property
+    def current_lane(self) -> int:
+        """The lane this backend's accessors currently address (always 0
+        for single-lane backends; the bound lane during batch dispatch).
+        Harness state that is per-guest (file tables, handle tables) must
+        be keyed by this."""
+        return 0
+
     # -- memory (backend.h:248-261, backend.cc:30-127) --------------------
     @abc.abstractmethod
     def virt_read(self, gva: int, size: int) -> bytes: ...

@@ -79,7 +79,13 @@ class EmuBackend(Backend):
                     result = Crash(f"crash-int-{e.rip:#x}")
                     break
                 except MemFault as e:
-                    kind = "write" if e.write else "read"
+                    # execute-refinement: a fault on the fetch address is an
+                    # exec A/V (reference refines A/Vs into read/write/
+                    # execute, crash_detection_umode.cc:104-121)
+                    if e.gva == rip and not e.write:
+                        kind = "execute"
+                    else:
+                        kind = "write" if e.write else "read"
                     result = Crash(f"crash-{kind}-{e.gva:#x}")
                     break
                 except DivideError:

@@ -206,7 +206,11 @@ class TpuBackend(Backend):
             return Crash(f"crash-int-{gva:#x}")
         if status == StatusCode.PAGE_FAULT:
             write = int(np.asarray(self.runner.machine.fault_write)[lane])
-            kind = "write" if write else "read"
+            rip = int(np.asarray(self.runner.machine.rip)[lane])
+            if gva == rip and not write:
+                kind = "execute"  # fetch-address fault (A/V-execute analog)
+            else:
+                kind = "write" if write else "read"
             return Crash(f"crash-{kind}-{gva:#x}")
         if status == StatusCode.DIVIDE_ERROR:
             rip = int(np.asarray(self.runner.machine.rip)[lane])
@@ -283,6 +287,10 @@ class TpuBackend(Backend):
         self._lane_results[self._lane] = result
 
     # -- registers / memory (current lane) ---------------------------------
+    @property
+    def current_lane(self) -> int:
+        return self._lane
+
     def get_reg(self, idx: int) -> int:
         return self._ensure_view().get_reg(self._lane, idx)
 
