@@ -23,3 +23,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the interpreter step function is large
+# (~40s per XLA compile on a 1-core box) and tests compile it for several
+# (lanes, chunk) shapes; caching across test processes cuts reruns from
+# ~10 min to ~2 min.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/wtf_tpu_xla"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)

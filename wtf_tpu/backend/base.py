@@ -116,6 +116,37 @@ class Backend(abc.ABC):
     def virt_write_dirty(self, gva: int, data: bytes) -> None:
         self.virt_write(gva, data)
 
+    def virt_translate(self, gva: int, write: bool = False) -> int:
+        """GVA -> GPA through the current lane's page tables (reference
+        backend.h:248; harnesses use it for page-boundary placement).
+        Raises the backend's fault type on non-present/non-writable."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement virt_translate")
+
+    def phys_translate(self, gpa: int) -> int:
+        """GPA -> backing offset (the reference returns a host pointer,
+        backend.h:255; page-granular identity here)."""
+        return gpa
+
+    def page_faults_memory_if_needed(self, gva: int, size: int) -> bool:
+        """Reference PageFaultsMemoryIfNeeded (backend.h:261,
+        bochscpu_backend.cc:917-999): inject #PF so the GUEST pages
+        memory in before a host write.  This design has no demand paging
+        — every snapshot page is materialized — so the check degenerates
+        to 'is the whole range mapped': True when the host may write it,
+        False when only guest execution (taking the real fault) could.
+        """
+        page = 0x1000
+        gva_end = gva + max(size, 1)
+        pos = gva & ~(page - 1)
+        try:
+            while pos < gva_end:
+                self.virt_translate(pos, write=True)
+                pos += page
+        except Exception:
+            return False
+        return True
+
     def virt_read_u64(self, gva: int) -> int:
         return int.from_bytes(self.virt_read(gva, 8), "little")
 
@@ -147,6 +178,18 @@ class Backend(abc.ABC):
         if addr is None:
             raise KeyError(f"symbol {symbol!r} not in symbol store")
         self.set_breakpoint(addr, handler)
+
+    def set_breakpoint_if_symbol(self, symbol: str,
+                                 handler: BreakpointHandler) -> bool:
+        """set_breakpoint_by_symbol, but skip-on-missing: hook sets
+        register detections only for symbols the snapshot carries (the
+        reference behaves the same for e.g. verifier hooks on targets
+        without app verifier, crash_detection_umode.cc:154-164)."""
+        addr = self.symbols.get(symbol)
+        if addr is None:
+            return False
+        self.set_breakpoint(addr, handler)
+        return True
 
     # -- coverage (backend.h:583-589) --------------------------------------
     @abc.abstractmethod
@@ -195,6 +238,7 @@ class Backend(abc.ABC):
         the name becomes the on-disk filename under crashes/."""
         self.stop(Crash(f"crash-{exception_kind}-{exception_address:#x}"))
 
+
     # -- batch facade ------------------------------------------------------
     def run_batch(self, insert: List[bytes], target) -> List[TestcaseResult]:
         """Run a list of testcases; returns one result each.
@@ -235,3 +279,20 @@ class Backend(abc.ABC):
 
     def print_run_stats(self) -> None:
         pass
+
+
+def guard_guest_faults(handler: BreakpointHandler) -> BreakpointHandler:
+    """Wrap a breakpoint handler that dereferences guest-controlled
+    pointers: a bad pointer must fail the TESTCASE (as the real kernel
+    would A/V probing a syscall argument), not escape and abort the
+    campaign."""
+    from wtf_tpu.cpu.emu import MemFault
+    from wtf_tpu.interp.runner import HostFault
+
+    def wrapped(backend):
+        try:
+            handler(backend)
+        except (MemFault, HostFault) as e:
+            kind = "write" if getattr(e, "write", False) else "read"
+            backend.save_crash(getattr(e, "gva", 0), kind)
+    return wrapped

@@ -48,9 +48,17 @@ class EmuBackend(Backend):
         self._run_cov = set()
         skip_rip = None  # one-shot bp suppression after handler resume
         result: TestcaseResult
-        trace = None
+        writer = None
+        tenet = False
         if self._trace_file is not None:
-            trace = open(self._trace_file, "w")
+            from wtf_tpu.trace import (
+                CovTraceWriter, RipTraceWriter, TenetTraceWriter,
+            )
+
+            cls = {"rip": RipTraceWriter, "cov": CovTraceWriter,
+                   "tenet": TenetTraceWriter}[self._trace_type]
+            writer = cls(self._trace_file)
+            tenet = self._trace_type == "tenet"
         try:
             while True:
                 if self.limit and cpu.icount >= self.limit:
@@ -67,12 +75,11 @@ class EmuBackend(Backend):
                         skip_rip = None
                     continue
                 skip_rip = None
-                if rip not in self._run_cov:
-                    self._run_cov.add(rip)
-                    if trace is not None and self._trace_type == "cov":
-                        trace.write(f"{rip:#x}\n")
-                if trace is not None and self._trace_type == "rip":
-                    trace.write(f"{rip:#x}\n")
+                self._run_cov.add(rip)
+                if writer is not None and not tenet:
+                    writer.on_step(rip)
+                if tenet:
+                    cpu.access_log = []
                 try:
                     cpu.step()
                 except GuestCrash as e:
@@ -94,14 +101,17 @@ class EmuBackend(Backend):
                 except UnsupportedInsn as e:
                     result = Crash(f"crash-unsupported-{e.rip:#x}")
                     break
+                if tenet:
+                    self._tenet_step(writer)
                 if cpu.cr3_event is not None:
                     if cpu.cr3_event != self.snapshot.cpu.cr3:
                         result = Cr3Change()
                         break
                     cpu.cr3_event = None
         finally:
-            if trace is not None:
-                trace.close()
+            if writer is not None:
+                writer.close()
+            cpu.access_log = None
             self._trace_file = None
         self.stats["runs"] += 1
         self.stats["instructions"] += cpu.icount
@@ -110,6 +120,24 @@ class EmuBackend(Backend):
         self._last_new = self._run_cov - self._aggregate_cov
         self._aggregate_cov |= self._last_new
         return result
+
+    def _tenet_step(self, writer) -> None:
+        """Post-instruction tenet delta: registers + the step's accesses
+        (data fetched post-insn like the reference, bochscpu:1276-1289)."""
+        cpu = self.cpu
+        accesses, cpu.access_log = cpu.access_log, None
+        regs = {name: cpu.gpr[i] for i, name in enumerate(
+            ("rax", "rcx", "rdx", "rbx", "rsp", "rbp", "rsi", "rdi",
+             "r8", "r9", "r10", "r11", "r12", "r13", "r14", "r15"))}
+        regs["rip"] = cpu.rip
+        resolved = []
+        for kind, gva, size in accesses or ():
+            try:
+                data = cpu.virt_read(gva, min(size, 64))
+            except MemFault:
+                continue  # e.g. the faulting access of a crashing insn
+            resolved.append((kind, gva, data))
+        writer.on_step(regs, resolved)
 
     def restore(self) -> None:
         self.cpu.restore()
@@ -131,6 +159,9 @@ class EmuBackend(Backend):
         self.cpu.rip = value & (1 << 64) - 1
 
     # -- memory ------------------------------------------------------------
+    def virt_translate(self, gva: int, write: bool = False) -> int:
+        return self.cpu.translate(gva, write)
+
     def virt_read(self, gva: int, size: int) -> bytes:
         return self.cpu.virt_read(gva, size)
 
@@ -145,6 +176,11 @@ class EmuBackend(Backend):
     def last_new_coverage(self) -> Set[int]:
         return set(self._last_new)
 
+    def aggregate_coverage(self) -> Set[int]:
+        """All RIPs covered so far this campaign (feeds the .cov-file
+        coverage report, reference coverage.cov aggregate README.md:166)."""
+        return set(self._aggregate_cov)
+
     def revoke_last_new_coverage(self) -> None:
         # reference client revokes after a timeout so flaky paths don't
         # enter the corpus (client.cc:122-125)
@@ -157,7 +193,7 @@ class EmuBackend(Backend):
         return self.cpu.rdrand_state
 
     def set_trace_file(self, path, trace_type: str) -> None:
-        if trace_type not in ("rip", "cov"):
+        if trace_type not in ("rip", "cov", "tenet"):
             raise ValueError(f"unsupported trace type {trace_type!r}")
         self._trace_file = Path(path)
         self._trace_type = trace_type

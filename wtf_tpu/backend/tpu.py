@@ -303,6 +303,9 @@ class TpuBackend(Backend):
     def set_rip(self, value: int) -> None:
         self._ensure_view().set_rip(self._lane, value)
 
+    def virt_translate(self, gva: int, write: bool = False) -> int:
+        return self._ensure_view().translate(self._lane, gva, write)
+
     def virt_read(self, gva: int, size: int) -> bytes:
         return self._ensure_view().virt_read(self._lane, gva, size)
 
@@ -320,6 +323,11 @@ class TpuBackend(Backend):
             return set()
         return set(self.runner.cache.rips_of_bits(self._last_new_words))
 
+    def aggregate_coverage(self) -> Set[int]:
+        """All RIPs covered so far this campaign (decoded from the device
+        aggregate bitmap)."""
+        return set(self.runner.cache.rips_of_bits(np.asarray(self._agg_cov)))
+
     def revoke_last_new_coverage(self) -> None:
         if self._last_new_words is not None:
             self._agg_cov = self._agg_cov & ~jnp.asarray(self._last_new_words)
@@ -333,10 +341,8 @@ class TpuBackend(Backend):
         return nxt
 
     def set_trace_file(self, path, trace_type: str) -> None:
-        if trace_type == "cov":
-            self._trace_request = (path, "cov")
-        elif trace_type == "rip":
-            self._trace_request = (path, "rip")
+        if trace_type in ("rip", "cov", "tenet"):
+            self._trace_request = (path, trace_type)
         else:
             raise ValueError(f"unsupported trace type {trace_type!r}")
 

@@ -72,6 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--trace-path", type=Path, default=None,
                      help="file (single input) or dir to write traces")
     run.add_argument("--trace-type", choices=TRACE_TYPES, default="rip")
+    run.add_argument("--coverage", type=Path, default=None,
+                     help="dir of .cov files (IDA/Binja/Ghidra exports); "
+                          "prints covered/total per run set")
     run.add_argument("--lanes", type=int, default=4)
 
     fuzz = sub.add_parser("fuzz", help="fuzz node (dials the master)")
@@ -185,6 +188,13 @@ def cmd_run(args) -> int:
                 crashes += 1
             print(f"{path.name}: {result} (|cov| = {len(coverage)})")
     backend.print_run_stats()
+    if args.coverage is not None:
+        from wtf_tpu.utils.covfiles import parse_cov_files
+
+        wanted = parse_cov_files(args.coverage)
+        covered = backend.aggregate_coverage() & wanted
+        print(f"coverage: {len(covered)}/{len(wanted)} "
+              f"listed basic blocks hit")
     return 0 if crashes == 0 else 2
 
 

@@ -13,7 +13,7 @@ from pathlib import Path
 from typing import Optional
 
 BACKENDS = ("emu", "tpu")
-TRACE_TYPES = ("rip", "cov")
+TRACE_TYPES = ("rip", "cov", "tenet")
 DEFAULT_ADDRESS = "tcp://localhost:31337/"  # wtf.cc:79,369
 
 

@@ -143,6 +143,9 @@ class EmuCpu:
         self.decode_cache: Dict[int, object] = {}
         # pfn -> rips decoded from that physical page (for SMC/restore flush)
         self.decode_pages: Dict[int, List[int]] = {}
+        # when a list, virt_read/virt_write append ("mr"/"mw", gva, size) —
+        # the tenet trace writer's lin_access-hook analog (SURVEY §5.1)
+        self.access_log = None
         self.load_state(state)
 
     # -- state ----------------------------------------------------------
@@ -219,6 +222,8 @@ class EmuCpu:
             gpa = self.translate(pos, write=False)
             out += self.mem.phys_read(gpa, chunk)
             pos += chunk
+        if self.access_log is not None and size > 0:
+            self.access_log.append(("mr", gva, size))
         return bytes(out)
 
     def virt_write(self, gva: int, data: bytes, enforce: bool = True) -> None:
@@ -230,6 +235,8 @@ class EmuCpu:
             gpa = self.translate(addr, write=enforce)
             self.mem.phys_write(gpa, data[pos : pos + chunk])
             pos += chunk
+        if self.access_log is not None and data:
+            self.access_log.append(("mw", gva, len(data)))
 
     def read_u(self, gva: int, size: int) -> int:
         return int.from_bytes(self.virt_read(gva, size), "little")
@@ -376,7 +383,13 @@ class EmuCpu:
 
     def step(self) -> None:
         """Execute exactly one instruction (one uop)."""
-        uop = self.fetch_decode()
+        # fetches are not data accesses: keep them out of the access log
+        # (bochs' lin_access hook fires for data, not fetch)
+        log, self.access_log = self.access_log, None
+        try:
+            uop = self.fetch_decode()
+        finally:
+            self.access_log = log
         self.execute(uop)
         self.icount += 1
 

@@ -81,11 +81,20 @@ class Server:
         self.print_stats = print_stats
         # seed paths: biggest first (server.h:399-414)
         self.paths: List[bytes] = []
-        if inputs_dir and Path(inputs_dir).is_dir():
-            files = sorted((p for p in Path(inputs_dir).iterdir()
-                            if p.is_file()),
-                           key=lambda p: p.stat().st_size, reverse=True)
-            self.paths = [p.read_bytes() for p in files]
+        self._dirwatch = None
+        self._dirwatch_last = 0.0
+        if inputs_dir:
+            if Path(inputs_dir).is_dir():
+                files = sorted((p for p in Path(inputs_dir).iterdir()
+                                if p.is_file()),
+                               key=lambda p: p.stat().st_size, reverse=True)
+                self.paths = [p.read_bytes() for p in files]
+            # mid-campaign injection: operators drop seeds into inputs/
+            # while the master runs (reference dirwatch.h); constructed
+            # even when the dir doesn't exist yet — it may appear later
+            from wtf_tpu.fuzz.dirwatch import DirWatcher
+
+            self._dirwatch = DirWatcher(inputs_dir)
         self.coverage: Set[int] = set()
         self.mutations = 0
         self.crash_names: Set[str] = set()
@@ -154,6 +163,21 @@ class Server:
                         self._clients[conn] = False
                         continue
                     self._on_readable(sock)
+                now = time.time()
+                if (self._dirwatch is not None
+                        and now - self._dirwatch_last >= 1.0):
+                    # throttled: a directory scan per reactor pass would
+                    # steal time from serving nodes on a hot master
+                    self._dirwatch_last = now
+                    injected = []
+                    for path in self._dirwatch.poll():
+                        try:
+                            injected.append(path.read_bytes()[:self.max_len])
+                        except OSError:
+                            continue  # vanished after the scan
+                    # prepend: freshly dropped seeds are served next,
+                    # ahead of any undrained initial corpus
+                    self.paths[:0] = injected
                 self._maybe_print()
         finally:
             for sock in list(self._clients):

@@ -28,8 +28,9 @@ Crash naming convention shared with the backends' intrinsic detections:
 
 from __future__ import annotations
 
+from wtf_tpu.backend.base import guard_guest_faults
 from wtf_tpu.core import nt
-from wtf_tpu.core.results import Crash, Ok, Timedout
+from wtf_tpu.core.results import Crash, Timedout
 
 # Symbol names the hook sets look for (targets alias their own routines
 # to these in their symbol stores, like real snapshots carry the Windows
@@ -39,14 +40,6 @@ SYM_DISPATCH_EXCEPTION = "ntdll!RtlDispatchException"
 SYM_SECURITY_CHECK = "ntdll!KiRaiseSecurityCheckFailure"
 SYM_VERIFIER_STOP = "verifier!VerifierStopMessage"
 SYM_PERF_INTERRUPT = "hal!HalpPerfInterrupt"
-
-
-def _bp_if_present(backend, name: str, handler) -> bool:
-    addr = backend.symbols.get(name)
-    if addr is None:
-        return False
-    backend.set_breakpoint(addr, handler)
-    return True
 
 
 def setup_kernel_crash_detection(backend) -> None:
@@ -59,9 +52,9 @@ def setup_kernel_crash_detection(backend) -> None:
         arg0 = b.get_reg(2)                    # rdx
         b.stop(Crash(f"crash-bugcheck-{code:#x}-{arg0:#x}"))
 
-    _bp_if_present(backend, SYM_BUGCHECK, on_bugcheck)
-    _bp_if_present(backend, SYM_PERF_INTERRUPT,
-                   lambda b: b.stop(Timedout()))
+    backend.set_breakpoint_if_symbol(SYM_BUGCHECK, on_bugcheck)
+    backend.set_breakpoint_if_symbol(SYM_PERF_INTERRUPT,
+                                     lambda b: b.stop(Timedout()))
 
 
 def setup_usermode_crash_detection(backend) -> None:
@@ -93,6 +86,9 @@ def setup_usermode_crash_detection(backend) -> None:
     def on_verifier_stop(b) -> None:
         b.save_crash(b.get_rip(), "heap-corruption")
 
-    _bp_if_present(backend, SYM_DISPATCH_EXCEPTION, on_dispatch_exception)
-    _bp_if_present(backend, SYM_SECURITY_CHECK, on_security_check)
-    _bp_if_present(backend, SYM_VERIFIER_STOP, on_verifier_stop)
+    # the record pointer is guest-controlled: a corrupt rcx names a crash
+    # instead of escaping the dispatch (guard_guest_faults)
+    backend.set_breakpoint_if_symbol(
+        SYM_DISPATCH_EXCEPTION, guard_guest_faults(on_dispatch_exception))
+    backend.set_breakpoint_if_symbol(SYM_SECURITY_CHECK, on_security_check)
+    backend.set_breakpoint_if_symbol(SYM_VERIFIER_STOP, on_verifier_stop)

@@ -252,25 +252,23 @@ class GuestFs:
             SYM_NTCLOSE: self._on_close,
         }
         for name, handler in hooks.items():
-            addr = backend.symbols.get(name)
-            if addr is not None:
-                backend.set_breakpoint(addr, self._guard(handler))
+            backend.set_breakpoint_if_symbol(name, self._guard(handler))
 
     def _guard(self, handler):
-        """A guest-controlled bad pointer in a syscall argument must fail
-        the TESTCASE (as the real kernel would A/V probing it), not the
-        campaign."""
+        """guard_guest_faults (base.py) semantics plus a stats counter: a
+        guest-controlled bad pointer in a syscall argument fails the
+        TESTCASE, not the campaign."""
         from wtf_tpu.cpu.emu import MemFault
         from wtf_tpu.interp.runner import HostFault
 
-        def wrapped(b):
+        def with_stats(b):
             try:
                 handler(b)
             except (MemFault, HostFault) as e:
                 self.stats["faults"] += 1
                 kind = "write" if getattr(e, "write", False) else "read"
                 b.save_crash(getattr(e, "gva", 0), kind)
-        return wrapped
+        return with_stats
 
     # -- syscall fakes (fshooks.cc:115-929) --------------------------------
     def _object_name(self, b, objattr_ptr: int) -> str:
@@ -329,7 +327,10 @@ class GuestFs:
             b.simulate_return_from_function(nt.STATUS_INVALID_PARAMETER)
             return
         data = f.read(length, offset)
-        status = nt.STATUS_SUCCESS if data else nt.STATUS_END_OF_FILE
+        # a zero-length read at a valid position is SUCCESS (Information=0)
+        # on real NT; END_OF_FILE only when bytes were wanted and none left
+        status = (nt.STATUS_SUCCESS if data or length == 0
+                  else nt.STATUS_END_OF_FILE)
         if data:
             b.virt_write(buffer, data)
         if iosb_ptr:

@@ -175,6 +175,8 @@ class HostView:
                 self.phys_read(lane, table + index * 8, 8), "little")
             if not entry & PTE_P:
                 raise HostFault(gva, write)
+            if write and not entry & PTE_W:
+                raise HostFault(gva, write)
             if large_mask is not None and entry & PTE_PS:
                 return (entry & large_mask) | (gva & ((1 << shift) - 1))
             if shift == 12:

@@ -1,0 +1,5 @@
+"""Symbolization layer (reference L3, debugger.{h,cc})."""
+
+from wtf_tpu.symbols.debugger import Debugger
+
+__all__ = ["Debugger"]

@@ -1,0 +1,7 @@
+"""Execution-trace writers (reference TraceType_t surface, SURVEY §5.1)."""
+
+from wtf_tpu.trace.writers import (
+    CovTraceWriter, RipTraceWriter, TenetTraceWriter,
+)
+
+__all__ = ["CovTraceWriter", "RipTraceWriter", "TenetTraceWriter"]

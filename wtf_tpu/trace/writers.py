@@ -1,0 +1,73 @@
+"""Trace writers: rip, cov (unique rip), tenet.
+
+Formats match the reference so its downstream tooling works unchanged:
+  rip    one hex RIP per executed instruction
+         (bochscpu_backend.cc:507-519; fed to the external `symbolizer`)
+  cov    one hex RIP per FIRST execution (unique rips)
+  tenet  per-instruction register deltas + memory accesses for the Tenet
+         trace explorer (DumpTenetDelta, bochscpu_backend.cc:1215-1323):
+         'reg=0x..,reg=0x..' changed registers (full set on the first
+         line), ',mr=0xADDR:HEXBYTES' / ',mw=...' per access.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# reference dump order (DumpTenetDelta): note rbx/rcx swapped vs x86
+# encoding order, rip last
+_TENET_REGS = ("rax", "rbx", "rcx", "rdx", "rbp", "rsp", "rsi", "rdi",
+               "r8", "r9", "r10", "r11", "r12", "r13", "r14", "r15", "rip")
+
+
+class RipTraceWriter:
+    def __init__(self, path):
+        self._fh = open(Path(path), "w")
+
+    def on_step(self, rip: int) -> None:
+        self._fh.write(f"{rip:#x}\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class CovTraceWriter:
+    def __init__(self, path):
+        self._fh = open(Path(path), "w")
+        self._seen = set()
+
+    def on_step(self, rip: int) -> None:
+        if rip not in self._seen:
+            self._seen.add(rip)
+            self._fh.write(f"{rip:#x}\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class TenetTraceWriter:
+    """Register+memory delta lines.  Call on_step AFTER each instruction
+    with the post-state registers and that instruction's accesses."""
+
+    def __init__(self, path):
+        self._fh = open(Path(path), "w")
+        self._prev: Optional[Dict[str, int]] = None
+
+    def on_step(self, regs: Dict[str, int],
+                accesses: List[Tuple[str, int, bytes]] = ()) -> None:
+        parts = []
+        force = self._prev is None
+        for name in _TENET_REGS:
+            value = regs[name]
+            if force or value != self._prev.get(name):
+                parts.append(f"{name}={value:#x}")
+        line = ",".join(parts)
+        for kind, addr, data in accesses:
+            line += f",{kind}={addr:#x}:{data.hex().upper()}"
+        if line:
+            self._fh.write(line + "\n")
+        self._prev = dict(regs)
+
+    def close(self) -> None:
+        self._fh.close()
