@@ -121,11 +121,12 @@ def _microbench(snapshot) -> dict:
     rc = r._run_chunk
     m = rc(tab, r.physmem.image, r.machine, jnp.uint64(1 << 40))
     m.status.block_until_ready()  # compile + first chunk
+    ic0 = np.asarray(m.icount).copy()  # m is donated into the next call
     t0 = time.time()
     m2 = rc(tab, r.physmem.image, m, jnp.uint64(1 << 40))
     m2.status.block_until_ready()
     dt = time.time() - t0
-    instr = int((np.asarray(m2.icount) - np.asarray(m.icount)).sum())
+    instr = int((np.asarray(m2.icount) - ic0).sum())
     out["branchy_instr_per_s"] = round(instr / dt, 1)
     out["chunk512_wall_s"] = round(dt, 4)
     # servicing floor: chunk call with every lane terminal (early exit) —

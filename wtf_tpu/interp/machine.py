@@ -16,6 +16,7 @@ since the device never mutates it.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -131,6 +132,7 @@ def machine_init(
     )
 
 
+@partial(jax.jit, donate_argnums=(0,))
 def machine_restore(machine: Machine, snapshot_template: Machine) -> Machine:
     """Restore(): every lane back to the snapshot.  O(1) in guest memory —
     replaces the reference's dirty-page rewrite loops (SURVEY.md §5.4).
@@ -139,7 +141,13 @@ def machine_restore(machine: Machine, snapshot_template: Machine) -> Machine:
     small per-lane register/bookkeeping arrays are used; the overlay STORAGE
     always comes from the live machine and cov/edge are rebuilt as zeros, so
     build the template with `overlay_slots=0` to avoid holding a second
-    multi-GiB overlay buffer alive."""
+    multi-GiB overlay buffer alive.
+
+    Donation: `machine` is donated so the overlay storage is reset in
+    place (no copy of the [lanes, slots, 4096] buffer).  The template is
+    NOT donated — XLA copies its leaves into the output, so the result
+    never aliases the template and later run_chunk calls may donate the
+    machine freely."""
     return snapshot_template._replace(
         # Keep the overlay *storage* from the live machine so no new buffers
         # are allocated; reset just the indexing state.

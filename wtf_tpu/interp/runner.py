@@ -280,15 +280,14 @@ def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
         view.r["xmm"][lane, i, 1] = np.uint64(cpu.xmm[i][1] & MASK64)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _apply_page_writes(machine: Machine, lanes, pfns, pages, valid):
     """Apply K buffered (lane, pfn, page) writes into the batched overlay in
     one device call (lax.scan; K is padded to a bucket size host-side).
 
-    NOTE: no buffer donation — after machine_restore the machine shares the
-    template's buffers, and donating them would invalidate the template for
-    every later restore.  (Perf follow-up: keep the template host-side so
-    run_chunk/_apply calls can donate safely.)"""
+    The machine is donated (overlay mutates in place); machine_restore
+    copies template leaves so the live machine never aliases the pristine
+    template."""
     capacity = machine.overlay.pfn.shape[1]
 
     def body(overlay, item):

@@ -29,6 +29,8 @@ bochscpu vs KVM, collapsed into one machine.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -1227,23 +1229,32 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 _CHUNK_CACHE: dict = {}
 
 
-def make_run_chunk(n_steps: int):
+def make_run_chunk(n_steps: int, donate: bool = True):
     """Build (or fetch) the jitted chunk executor: up to n_steps vmapped
     transitions with early exit when no lane is RUNNING.  The host runner
     (interp/runner.py) calls this in a loop, servicing lane statuses between
     chunks — the batched analog of the reference's vmexit servicing
     (kvm_backend.cc:1371-1566).
 
-    Memoized per n_steps so every Runner with the same chunk size shares one
-    jit cache entry (XLA recompiles only on new array *shapes*, not per
-    Runner instance)."""
-    cached = _CHUNK_CACHE.get(n_steps)
+    Memoized per (n_steps, donate) so every Runner with the same chunk size
+    shares one jit cache entry (XLA recompiles only on new array *shapes*,
+    not per Runner instance).
+
+    donate=True (the runner's hot path): the machine argument is donated so
+    the dominant buffers (overlay data, cov/edge bitmaps) update in place
+    instead of being copied every chunk call — safe because machine_restore
+    copies template leaves rather than aliasing them, and the runner
+    reassigns its machine from the result.  Callers that reuse an argument
+    tuple across calls (the driver's entry() compile check) need
+    donate=False."""
+    key = (n_steps, donate)
+    cached = _CHUNK_CACHE.get(key)
     if cached is not None:
         return cached
 
     step_v = jax.vmap(step_lane, in_axes=(None, None, 0, None))
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(2,) if donate else ())
     def run_chunk(tab: UopTable, image: MemImage, machine: Machine, limit):
         def cond(carry):
             i, m = carry
@@ -1257,5 +1268,5 @@ def make_run_chunk(n_steps: int):
         _, out = lax.while_loop(cond, body, (jnp.int32(0), machine))
         return out
 
-    _CHUNK_CACHE[n_steps] = run_chunk
+    _CHUNK_CACHE[key] = run_chunk
     return run_chunk
