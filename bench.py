@@ -37,7 +37,7 @@ def worker() -> None:
     from wtf_tpu.backend import create_backend
     from wtf_tpu.fuzz.corpus import Corpus
     from wtf_tpu.fuzz.loop import FuzzLoop
-    from wtf_tpu.fuzz.mutator import MangleMutator
+    from wtf_tpu.fuzz.native_mutator import best_mangle_mutator
     from wtf_tpu.harness import demo_tlv
 
     if os.environ.get("BENCH_PLATFORM") == "cpu":
@@ -63,7 +63,7 @@ def worker() -> None:
     rng = random.Random(0x77F)
     corpus = Corpus(rng=rng)
     corpus.add(b"\x01\x04AAAA\x02\x08BBBBBBBB")
-    mutator = MangleMutator(rng, max_len=0x400)
+    mutator = best_mangle_mutator(rng, max_len=0x400)
     loop = FuzzLoop(backend, demo_tlv.TARGET, mutator, corpus)
 
     # warmup: first batches pay XLA compilation + decode servicing

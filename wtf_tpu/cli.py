@@ -142,11 +142,11 @@ def _build_backend(target, backend_name: str, paths: TargetPaths,
 
 
 def _mutator_for(target, rng: random.Random, max_len: int):
-    from wtf_tpu.fuzz.mutator import MangleMutator
+    from wtf_tpu.fuzz.native_mutator import best_mangle_mutator
 
     if target.create_mutator is not None:
         return target.create_mutator(rng, max_len)
-    return MangleMutator(rng, max_len)
+    return best_mangle_mutator(rng, max_len)
 
 
 # ---------------------------------------------------------------------------

@@ -78,8 +78,13 @@ class FuzzLoop:
 
     def run_one_batch(self) -> int:
         """Returns the number of crashes found in this batch."""
-        testcases = [self.mutator.get_new_testcase(self.corpus)
-                     for _ in range(self.batch_size)]
+        if hasattr(self.mutator, "get_new_batch"):
+            # native engines mutate the whole batch in one C call
+            testcases = self.mutator.get_new_batch(
+                self.corpus, self.batch_size)
+        else:
+            testcases = [self.mutator.get_new_testcase(self.corpus)
+                         for _ in range(self.batch_size)]
         results = self.backend.run_batch(testcases, self.target)
         crashes = 0
         for lane, (data, result) in enumerate(zip(testcases, results)):

@@ -59,8 +59,8 @@ class ByteMutator(Mutator):
     def get_new_testcase(self, corpus) -> bytes:
         base = corpus.pick() if corpus is not None else None
         if not base:
-            return bytes(self.rng.randrange(256)
-                         for _ in range(self.rng.randint(1, 64)))
+            n = self.rng.randint(1, min(64, self.max_len))
+            return bytes(self.rng.randrange(256) for _ in range(n))
         data = bytearray(base)
         self._mutate_once(data)
         return bytes(data[:self.max_len])
@@ -132,8 +132,8 @@ class MangleMutator(Mutator):
     def get_new_testcase(self, corpus) -> bytes:
         base = corpus.pick() if corpus is not None else None
         if not base:
-            return bytes(self.rng.randrange(256)
-                         for _ in range(self.rng.randint(1, 64)))
+            n = self.rng.randint(1, min(64, self.max_len))
+            return bytes(self.rng.randrange(256) for _ in range(n))
         data = bytearray(base)
         for _ in range(self.rng.randint(1, self.N_PER_RUN)):
             self._mangle(data)

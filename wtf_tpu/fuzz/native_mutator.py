@@ -1,0 +1,132 @@
+"""Native (C++) mutation engine binding.
+
+SURVEY §2.6: the reference's mutator engines are compiled code (LLVM
+libFuzzer's MutationDispatcher + the honggfuzz mangle port) because at
+target throughput a per-testcase interpreted mutation call dominates the
+host plane (round-2 VERDICT weak #7).  `NativeMangleMutator` drives
+native/mangle.cc over ctypes; `get_new_batch` mutates a whole device
+batch in ONE native call.  Falls back to the Python MangleMutator when no
+toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import random
+from typing import List, Optional
+
+import numpy as np
+
+from wtf_tpu.fuzz.mutator import MangleMutator, Mutator
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+
+def _native_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    from wtf_tpu.native import build_library
+
+    path = build_library("wtfmangle", ["mangle.cc"])
+    if path is None:
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.wtf_mangle.restype = ctypes.c_uint64
+    lib.wtf_mangle.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+    ]
+    lib.wtf_mangle_batch.restype = None
+    lib.wtf_mangle_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+    ]
+    _LIB = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _native_lib() is not None
+
+
+class NativeMangleMutator(Mutator):
+    """honggfuzz-mangle-role engine running in C++ (5 mutations per
+    testcase like the reference wiring, mutator.cc:66)."""
+
+    N_PER_RUN = 5
+
+    def __init__(self, rng: random.Random, max_len: int):
+        lib = _native_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native mangle library unavailable (no toolchain); "
+                "use create_mutator('mangle', ...) instead")
+        self._lib = lib
+        self.rng = rng
+        self.max_len = max_len
+        self._cross: Optional[bytes] = None
+
+    def on_new_coverage(self, testcase: bytes) -> None:
+        self._cross = testcase
+
+    def _cross_args(self):
+        if self._cross:
+            buf = (ctypes.c_uint8 * len(self._cross)).from_buffer_copy(
+                self._cross)
+            return buf, len(self._cross)
+        return None, 0
+
+    def _generate(self) -> bytes:
+        n = self.rng.randint(1, min(64, self.max_len))
+        return bytes(self.rng.randrange(256) for _ in range(n))
+
+    def get_new_testcase(self, corpus) -> bytes:
+        base = corpus.pick() if corpus is not None else None
+        if not base:
+            return self._generate()
+        buf = bytearray(base[:self.max_len].ljust(1, b"\x00"))
+        buf.extend(b"\x00" * (self.max_len - len(buf)))
+        arr = (ctypes.c_uint8 * self.max_len).from_buffer(buf)
+        cross, cross_len = self._cross_args()
+        new_len = self._lib.wtf_mangle(
+            arr, min(len(base), self.max_len), self.max_len,
+            self.rng.getrandbits(64), self.rng.randint(1, self.N_PER_RUN),
+            cross, cross_len)
+        return bytes(buf[:new_len])
+
+    def get_new_batch(self, corpus, count: int) -> List[bytes]:
+        """Mutate `count` testcases in one native call (one Python->C
+        transition per device batch)."""
+        cap = self.max_len
+        arena = np.zeros((count, cap), dtype=np.uint8)
+        lens = np.zeros(count, dtype=np.uint64)
+        for i in range(count):
+            base = corpus.pick() if corpus is not None else None
+            if not base:
+                fresh = self._generate()
+                arena[i, :len(fresh)] = np.frombuffer(fresh, dtype=np.uint8)
+                lens[i] = len(fresh)
+                continue
+            base = base[:cap]
+            arena[i, :len(base)] = np.frombuffer(base, dtype=np.uint8)
+            lens[i] = len(base)
+        cross, cross_len = self._cross_args()
+        self._lib.wtf_mangle_batch(
+            arena.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            cap, count, self.rng.getrandbits(64), self.N_PER_RUN,
+            cross, cross_len)
+        return [bytes(arena[i, :int(lens[i])].tobytes())
+                for i in range(count)]
+
+
+def best_mangle_mutator(rng: random.Random, max_len: int) -> Mutator:
+    """Native engine when the toolchain allows, Python otherwise."""
+    if native_available():
+        return NativeMangleMutator(rng, max_len)
+    return MangleMutator(rng, max_len)
