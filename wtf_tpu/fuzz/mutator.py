@@ -34,6 +34,13 @@ _MAGIC = [
 ]
 
 
+def generate_fresh(rng: random.Random, max_len: int) -> bytes:
+    """Empty-corpus testcase synthesis, shared by every engine: 1..64
+    random bytes, bounded by the campaign's max_len contract."""
+    n = rng.randint(1, min(64, max(1, max_len)))
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
 class Mutator:
     """Interface (reference mutator.h:10-20)."""
 
@@ -59,8 +66,7 @@ class ByteMutator(Mutator):
     def get_new_testcase(self, corpus) -> bytes:
         base = corpus.pick() if corpus is not None else None
         if not base:
-            n = self.rng.randint(1, min(64, self.max_len))
-            return bytes(self.rng.randrange(256) for _ in range(n))
+            return generate_fresh(self.rng, self.max_len)
         data = bytearray(base)
         self._mutate_once(data)
         return bytes(data[:self.max_len])
@@ -132,8 +138,7 @@ class MangleMutator(Mutator):
     def get_new_testcase(self, corpus) -> bytes:
         base = corpus.pick() if corpus is not None else None
         if not base:
-            n = self.rng.randint(1, min(64, self.max_len))
-            return bytes(self.rng.randrange(256) for _ in range(n))
+            return generate_fresh(self.rng, self.max_len)
         data = bytearray(base)
         for _ in range(self.rng.randint(1, self.N_PER_RUN)):
             self._mangle(data)

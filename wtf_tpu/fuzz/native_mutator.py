@@ -70,6 +70,7 @@ class NativeMangleMutator(Mutator):
         self.rng = rng
         self.max_len = max_len
         self._cross: Optional[bytes] = None
+        self._arena: Optional[np.ndarray] = None  # reused across batches
 
     def on_new_coverage(self, testcase: bytes) -> None:
         self._cross = testcase
@@ -81,14 +82,12 @@ class NativeMangleMutator(Mutator):
             return buf, len(self._cross)
         return None, 0
 
-    def _generate(self) -> bytes:
-        n = self.rng.randint(1, min(64, self.max_len))
-        return bytes(self.rng.randrange(256) for _ in range(n))
-
     def get_new_testcase(self, corpus) -> bytes:
+        from wtf_tpu.fuzz.mutator import generate_fresh
+
         base = corpus.pick() if corpus is not None else None
         if not base:
-            return self._generate()
+            return generate_fresh(self.rng, self.max_len)
         buf = bytearray(base[:self.max_len].ljust(1, b"\x00"))
         buf.extend(b"\x00" * (self.max_len - len(buf)))
         arr = (ctypes.c_uint8 * self.max_len).from_buffer(buf)
@@ -101,26 +100,42 @@ class NativeMangleMutator(Mutator):
 
     def get_new_batch(self, corpus, count: int) -> List[bytes]:
         """Mutate `count` testcases in one native call (one Python->C
-        transition per device batch)."""
-        cap = self.max_len
-        arena = np.zeros((count, cap), dtype=np.uint8)
-        lens = np.zeros(count, dtype=np.uint64)
-        for i in range(count):
+        transition per device batch).
+
+        The arena stride is sized to what this batch can actually grow to
+        — NOT max_len, which defaults to 1 MiB and would make the arena a
+        gigabyte at 1024 lanes.  Per-item growth per call is bounded by
+        the op table: <= N_PER_RUN inserts of <= 16 bytes plus one
+        cross-over splice (<= len + cross_len).  The arena is kept across
+        batches and only reallocated when it must grow."""
+        from wtf_tpu.fuzz.mutator import generate_fresh
+
+        bases: List[bytes] = []
+        for _ in range(count):
             base = corpus.pick() if corpus is not None else None
             if not base:
-                fresh = self._generate()
-                arena[i, :len(fresh)] = np.frombuffer(fresh, dtype=np.uint8)
-                lens[i] = len(fresh)
-                continue
-            base = base[:cap]
+                base = generate_fresh(self.rng, self.max_len)
+            bases.append(base[:self.max_len])
+        cross_len = len(self._cross) if self._cross else 0
+        max_base = max(len(b) for b in bases)
+        cap = min(self.max_len,
+                  max(64, max_base + 16 * self.N_PER_RUN + cross_len))
+        arena = self._arena
+        if (arena is None or arena.shape[0] < count
+                or arena.shape[1] < cap):
+            arena = np.zeros((count, cap), dtype=np.uint8)
+            self._arena = arena
+        cap = arena.shape[1]
+        lens = np.zeros(count, dtype=np.uint64)
+        for i, base in enumerate(bases):
             arena[i, :len(base)] = np.frombuffer(base, dtype=np.uint8)
             lens[i] = len(base)
-        cross, cross_len = self._cross_args()
+        cross, cross_n = self._cross_args()
         self._lib.wtf_mangle_batch(
             arena.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             cap, count, self.rng.getrandbits(64), self.N_PER_RUN,
-            cross, cross_len)
+            cross, cross_n)
         return [bytes(arena[i, :int(lens[i])].tobytes())
                 for i in range(count)]
 

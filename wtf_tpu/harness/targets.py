@@ -80,4 +80,6 @@ def load_builtin_targets() -> None:
     """Import the in-tree demo target modules so their self-registration
     runs (the reference compiles fuzzer_*.cc into the binary; our
     equivalent is importing the harness modules)."""
-    from wtf_tpu.harness import demo_maze, demo_tlv  # noqa: F401
+    from wtf_tpu.harness import (  # noqa: F401
+        demo_fs, demo_ioctl, demo_kernel, demo_maze, demo_tlv,
+    )

@@ -33,6 +33,32 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devices), (LANE_AXIS,))
 
 
+def init_multihost(coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> Mesh:
+    """Multi-host campaign entry point: join the jax distributed runtime
+    (DCN coordination; args default from the cluster environment) and
+    return the global lane mesh over every chip of every host.
+
+    This replaces the reference's process-per-core fan-out INSIDE the
+    pod: one mesh, lanes sharded across all chips, coverage OR-reduce
+    riding ICI within hosts and DCN across (XLA picks the collectives).
+    Across independent pods, the TCP master/node plane (wtf_tpu.dist)
+    still applies unchanged — a whole pod is one BatchClient."""
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError:
+        pass  # already initialized (e.g. a second campaign this process)
+    return make_mesh()
+
+
 def shard_machine(machine: Machine, mesh: Mesh) -> Machine:
     """Place every per-lane leaf with its leading axis split over the mesh.
 
