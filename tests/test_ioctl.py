@@ -51,7 +51,7 @@ def test_dynamic_exit_breakpoint():
     assert di.EXIT_GVA in backend.breakpoints
 
 
-def test_ioctl_fuzz_finds_oob(  ):
+def test_ioctl_fuzz_finds_oob():
     backend = make_backend("emu")
     rng = random.Random(4)
     corpus = Corpus(rng=rng)

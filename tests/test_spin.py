@@ -1,0 +1,64 @@
+"""Deep-execution target + adaptive chunk growth tests (the BASELINE
+config-5 shape: very long executions per testcase)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from wtf_tpu.backend import create_backend
+from wtf_tpu.core.results import Ok, Timedout
+from wtf_tpu.harness import demo_spin as ds
+
+
+def make_backend(name, **kw):
+    backend = create_backend(name, ds.build_snapshot(), **kw)
+    backend.initialize()
+    ds.TARGET.init(backend)
+    return backend
+
+
+def spin(k):
+    return struct.pack("<I", k)
+
+
+def test_spin_depth_scales_with_input():
+    backend = make_backend("emu")
+    for k in (0, 10, 500):
+        results = backend.run_batch([spin(k)], ds.TARGET)
+        assert isinstance(results[0], Ok)
+        got = backend.cpu.icount
+        assert got == pytest.approx(k * ds.INSNS_PER_ITER, abs=16), (k, got)
+        ds.TARGET.restore()
+        backend.restore()
+
+
+def test_adaptive_chunks_reduce_round_trips():
+    """Same results, far fewer host<->device round trips once the decode
+    cache warms up (the deep-execution throughput lever)."""
+    results = {}
+    for adaptive in (False, True):
+        backend = make_backend("tpu", n_lanes=4, chunk_steps=64)
+        backend.runner.adaptive_chunks = adaptive
+        res = backend.run_batch([spin(3000)] * 4, ds.TARGET)
+        assert all(isinstance(r, Ok) for r in res)
+        results[adaptive] = (
+            int(np.asarray(backend.runner.machine.icount).sum()),
+            backend.runner.stats["chunks"],
+        )
+    instr_fixed, chunks_fixed = results[False]
+    instr_adaptive, chunks_adaptive = results[True]
+    assert instr_fixed == instr_adaptive  # bit-identical execution
+    assert chunks_adaptive < chunks_fixed / 5
+
+
+def test_deep_timeout_is_instruction_precise():
+    """The limit check runs per device step, so TIMEDOUT lands on the
+    exact instruction budget even inside a 16k-step chunk."""
+    limit = 5000
+    backend = make_backend("tpu", n_lanes=2, chunk_steps=64, limit=limit)
+    res = backend.run_batch([spin(1 << 24), spin(3)], ds.TARGET)
+    assert isinstance(res[0], Timedout)
+    assert isinstance(res[1], Ok)
+    icount = np.asarray(backend.runner.machine.icount)
+    assert int(icount[0]) == limit

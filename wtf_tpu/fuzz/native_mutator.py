@@ -17,7 +17,9 @@ from typing import List, Optional
 
 import numpy as np
 
-from wtf_tpu.fuzz.mutator import MangleMutator, Mutator
+from wtf_tpu.fuzz.mutator import (
+    MangleMutator, Mutator, generate_fresh,
+)
 
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_TRIED = False
@@ -83,8 +85,6 @@ class NativeMangleMutator(Mutator):
         return None, 0
 
     def get_new_testcase(self, corpus) -> bytes:
-        from wtf_tpu.fuzz.mutator import generate_fresh
-
         base = corpus.pick() if corpus is not None else None
         if not base:
             return generate_fresh(self.rng, self.max_len)
@@ -105,11 +105,8 @@ class NativeMangleMutator(Mutator):
         The arena stride is sized to what this batch can actually grow to
         — NOT max_len, which defaults to 1 MiB and would make the arena a
         gigabyte at 1024 lanes.  Per-item growth per call is bounded by
-        the op table: <= N_PER_RUN inserts of <= 16 bytes plus one
-        cross-over splice (<= len + cross_len).  The arena is kept across
-        batches and only reallocated when it must grow."""
-        from wtf_tpu.fuzz.mutator import generate_fresh
-
+        the op table (inserts and cross-over splices).  The arena is kept
+        across batches and only reallocated when it must grow."""
         bases: List[bytes] = []
         for _ in range(count):
             base = corpus.pick() if corpus is not None else None
@@ -118,8 +115,10 @@ class NativeMangleMutator(Mutator):
             bases.append(base[:self.max_len])
         cross_len = len(self._cross) if self._cross else 0
         max_base = max(len(b) for b in bases)
+        # each of the <= N_PER_RUN ops can grow by an insert (<=16B) or a
+        # cross-over splice (<= cross_len), so bound by the worst op mix
         cap = min(self.max_len,
-                  max(64, max_base + 16 * self.N_PER_RUN + cross_len))
+                  max(64, max_base + self.N_PER_RUN * max(16, cross_len)))
         arena = self._arena
         if (arena is None or arena.shape[0] < count
                 or arena.shape[1] < cap):

@@ -345,6 +345,18 @@ class Runner:
         self._run_chunk = make_run_chunk(chunk_steps)
         self.lane_errors: Dict[int, str] = {}
         self._smc_updates: Dict[int, int] = {}
+        # Adaptive chunk growth for deep executions (BASELINE config 5 is
+        # 100M instructions/testcase): once a chunk completes with nothing
+        # to service and lanes still running — i.e. the decode cache is
+        # warm and the guest is just executing — step up to a larger
+        # chunk so host round trips stop dominating.  Any serviceable
+        # status drops back to the base size for responsive servicing.
+        # Sizes are sparse (x16) to bound the number of XLA compiles.
+        self.adaptive_chunks = True
+        self._chunk_sizes = [chunk_steps]
+        while self._chunk_sizes[-1] * 16 <= (1 << 16):
+            self._chunk_sizes.append(self._chunk_sizes[-1] * 16)
+        self._chunk_level = 0
         # run statistics (reference PrintRunStats role, backend.h:218)
         self.stats = {
             "chunks": 0, "decodes": 0, "fallbacks": 0, "smc_updates": 0,
@@ -492,8 +504,11 @@ class Runner:
         array."""
         tab = self.cache.device()
         limit = jnp.uint64(self.limit)
+        self._chunk_level = 0
         for _ in range(max_chunks):
-            self.machine = self._run_chunk(
+            run_chunk = (make_run_chunk(self._chunk_sizes[self._chunk_level])
+                         if self.adaptive_chunks else self._run_chunk)
+            self.machine = run_chunk(
                 tab, self.physmem.image, self.machine, limit)
             self.stats["chunks"] += 1
             status = np.asarray(self.machine.status)
@@ -510,7 +525,12 @@ class Runner:
             if total == 0:
                 if not running.any():
                     return status
+                # nothing to service, lanes still running: grow the chunk
+                if (self.adaptive_chunks
+                        and self._chunk_level < len(self._chunk_sizes) - 1):
+                    self._chunk_level += 1
                 continue
+            self._chunk_level = 0  # servicing needed: back to fine-grained
 
             view = self.view()
             if need[int(StatusCode.NEED_DECODE)]:

@@ -52,10 +52,8 @@ def init_multihost(coordinator: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    try:
-        jax.distributed.initialize(**kwargs)
-    except RuntimeError:
-        pass  # already initialized (e.g. a second campaign this process)
+    if not getattr(jax.distributed.global_state, "client", None):
+        jax.distributed.initialize(**kwargs)  # raises on a bad coordinator
     return make_mesh()
 
 
