@@ -52,7 +52,7 @@ def init_multihost(coordinator: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    if not getattr(jax.distributed.global_state, "client", None):
+    if not jax.distributed.is_initialized():
         jax.distributed.initialize(**kwargs)  # raises on a bad coordinator
     return make_mesh()
 
