@@ -117,7 +117,8 @@ def test_fuzz_to_crash_single_client(tmp_path):
     corpus = Corpus(rng=rng)
     corpus.add(BENIGN)
     server = Server(_addr(tmp_path), TlvStructureMutator(rng, 128), corpus,
-                    crashes_dir=tmp_path / "crashes", runs=800)
+                    crashes_dir=tmp_path / "crashes", runs=800,
+                    coverage_path=tmp_path / "coverage.cov")
     thread = _serve(server, seconds=120)
     backend = create_backend("emu", demo_tlv.build_snapshot(), limit=50_000)
     backend.initialize()
@@ -130,6 +131,10 @@ def test_fuzz_to_crash_single_client(tmp_path):
     assert crashes, "no crash file saved"
     assert any(p.name.startswith("crash-") for p in crashes)
     assert len(server.coverage) > 0
+    # aggregate coverage persisted in the .cov format we also ingest
+    from wtf_tpu.utils.covfiles import parse_cov_files
+
+    assert parse_cov_files(tmp_path) == server.coverage
 
 
 def test_batch_client_looks_like_n_nodes(tmp_path):

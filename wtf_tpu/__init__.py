@@ -8,18 +8,21 @@ image, with lane-masked divergent control flow, device-side coverage bitmaps,
 and dirty-page restore as O(1) overlay reset.
 
 Layering (mirrors SURVEY.md section 1's layer map, redesigned TPU-first):
-  core/     - strong address types, CpuState, options, result variants (L1)
+  core/     - strong address types, CpuState, NT structs, results    (L1)
   snapshot/ - snapshot loaders: kdmp / raw / synthetic               (L1)
   mem/      - physical memory image, paging, per-lane dirty overlay  (L1/L2)
+  cpu/      - decoder, uops, host oracle interpreter                 (L2)
   interp/   - the vmapped fetch-decode-execute x86-64 interpreter    (L2)
-  backend/  - Backend contract + TpuBackend                          (L2)
-  symbols/  - symbol store (debugger layer, Linux-mode path)         (L3)
-  harness/  - target registry, crash detection, guest-fs emulation   (L4)
-  fuzz/     - corpus, mutators                                       (L5)
-  dist/     - master/client TCP plane                                (L5)
+  backend/  - Backend contract + EmuBackend / TpuBackend             (L2)
+  symbols/  - symbol store + address<->name (debugger layer)         (L3)
+  harness/  - target registry, crash detection, guest-fs, demos      (L4)
+  fuzz/     - corpus, mutators (python + native), dirwatch, loop     (L5)
+  dist/     - master/node wire protocol + reactor                    (L5)
   parallel/ - device mesh sharding, multi-chip coverage reduction    (L5)
   trace/    - rip/cov/tenet trace writers                            (aux)
-  cli.py    - `master|fuzz|run` subcommands                          (L6)
+  native/   - on-demand-built C++ components (kdmp, mangle)          (aux)
+  cli.py    - `master|fuzz|run|campaign` subcommands                 (L6)
+  config.py - per-subcommand options objects + path conventions      (L6)
 """
 
 import os

@@ -93,6 +93,9 @@ class TpuBackend(Backend):
         self._agg_edge = None
         self._last_new_words: Optional[np.ndarray] = None
         self._trace_request = None
+        # per-campaign counters (reference BochscpuRunStats_t role,
+        # bochscpu_backend.h:17-45)
+        self.stats = {"batches": 0, "testcases": 0, "instructions": 0}
 
     # -- lifecycle ---------------------------------------------------------
     def initialize(self) -> None:
@@ -163,6 +166,10 @@ class TpuBackend(Backend):
             self._agg_cov, self._agg_edge, m.cov, m.edge, include)
         self._new_lane = np.asarray(new_lane)
         self._last_new_words = np.asarray(new_words)
+        self.stats["batches"] += 1
+        self.stats["testcases"] += n_active
+        self.stats["instructions"] += int(
+            np.asarray(m.icount)[:n_active].sum())
 
         return [self._map_result(lane, statuses[lane])
                 for lane in range(n_active)]
@@ -243,6 +250,9 @@ class TpuBackend(Backend):
             self._agg_cov, self._agg_edge, m.cov, m.edge, include)
         self._new_lane = np.asarray(new_lane)
         self._last_new_words = np.asarray(new_words)
+        self.stats["batches"] += 1
+        self.stats["testcases"] += 1
+        self.stats["instructions"] += int(np.asarray(m.icount)[0])
         return self._map_result(0, statuses[0])
 
     def _run_traced(self) -> TestcaseResult:
@@ -348,9 +358,15 @@ class TpuBackend(Backend):
 
     def print_run_stats(self) -> None:
         s = self.runner.stats
-        print(f"[tpu] lanes={self.n_lanes} chunks={s['chunks']} "
-              f"decodes={s['decodes']} fallbacks={s['fallbacks']} "
-              f"bp_dispatches={s['bp_dispatches']}")
+        from wtf_tpu.utils.human import number_to_human as h
+
+        print(f"[tpu] lanes={self.n_lanes} "
+              f"testcases={h(self.stats['testcases'])} "
+              f"batches={self.stats['batches']} "
+              f"instructions={h(self.stats['instructions'])} "
+              f"chunks={s['chunks']} decodes={s['decodes']} "
+              f"fallbacks={s['fallbacks']} "
+              f"smc={s['smc_updates']} bp_dispatches={s['bp_dispatches']}")
 
 
 def _result_status(result: TestcaseResult) -> StatusCode:

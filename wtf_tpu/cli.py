@@ -225,10 +225,13 @@ def cmd_master(args) -> int:
     target = _lookup_target(args)
     rng = random.Random(opts.seed or None)
     corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
+    coverage_path = (Path(opts.paths.target) / "coverage.cov"
+                     if opts.paths.target else None)
     server = Server(opts.address, _mutator_for(target, rng, opts.max_len),
                     corpus, inputs_dir=opts.paths.inputs,
                     crashes_dir=opts.paths.crashes, runs=opts.runs,
-                    max_len=opts.max_len, print_stats=True)
+                    max_len=opts.max_len, print_stats=True,
+                    coverage_path=coverage_path)
     stats = server.run()
     print(server.stats.line(len(server.coverage), len(corpus), 0))
     return 0 if stats.crashes == 0 else 2

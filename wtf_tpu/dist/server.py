@@ -67,6 +67,7 @@ class Server:
         max_len: int = 1024 * 1024,
         stats_every: float = 10.0,
         print_stats: bool = False,
+        coverage_path: Optional[Path] = None,
     ):
         self.address = address
         self.mutator = mutator
@@ -76,6 +77,7 @@ class Server:
             self.crashes_dir.mkdir(parents=True, exist_ok=True)
         self.runs = runs
         self.max_len = max_len
+        self.coverage_path = Path(coverage_path) if coverage_path else None
         self.stats = ServerStats()
         self.stats_every = stats_every
         self.print_stats = print_stats
@@ -185,7 +187,22 @@ class Server:
             self._clients.clear()
             self._listener.close()
             self._listener = None
+            self._write_coverage()
         return self.stats
+
+    def _write_coverage(self) -> None:
+        """Persist the aggregate coverage in the .cov JSON shape
+        (reference coverage.cov aggregate, README.md:166; format of
+        utils/covfiles.py) so campaigns resume/compare offline."""
+        if self.coverage_path is None:
+            return
+        import json
+
+        self.coverage_path.parent.mkdir(parents=True, exist_ok=True)
+        self.coverage_path.write_text(json.dumps({
+            "name": "aggregate",
+            "addresses": [hex(a) for a in sorted(self.coverage)],
+        }))
 
     def _feed(self, sock: socket.socket) -> None:
         testcase = self.get_testcase()
