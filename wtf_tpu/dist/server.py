@@ -192,17 +192,22 @@ class Server:
 
     def _write_coverage(self) -> None:
         """Persist the aggregate coverage in the .cov JSON shape
-        (reference coverage.cov aggregate, README.md:166; format of
-        utils/covfiles.py) so campaigns resume/compare offline."""
+        (reference coverage.cov aggregate, README.md:166; integer
+        addresses per the gen_coveragefile_* format) so campaigns
+        resume/compare offline.  Best-effort: runs in the reactor's
+        finally block and must not mask an in-flight exception."""
         if self.coverage_path is None:
             return
         import json
 
-        self.coverage_path.parent.mkdir(parents=True, exist_ok=True)
-        self.coverage_path.write_text(json.dumps({
-            "name": "aggregate",
-            "addresses": [hex(a) for a in sorted(self.coverage)],
-        }))
+        try:
+            self.coverage_path.parent.mkdir(parents=True, exist_ok=True)
+            self.coverage_path.write_text(json.dumps({
+                "name": "aggregate",
+                "addresses": sorted(self.coverage),
+            }))
+        except OSError as e:
+            print(f"coverage.cov write failed: {e}")
 
     def _feed(self, sock: socket.socket) -> None:
         testcase = self.get_testcase()

@@ -116,7 +116,9 @@ class HostView:
         if len(slots) == 0:
             return None
         data = self.runner.machine.overlay.data[lane, int(slots[0])]
-        return bytes(np.asarray(data))
+        # overlay rows are little-endian u64 words; tobytes() on a LE host
+        # yields the byte image
+        return np.asarray(data).tobytes()
 
     def page(self, lane: int, pfn: int) -> bytes:
         """Current contents of a guest-physical page as this lane sees it."""
@@ -391,7 +393,7 @@ class Runner:
                 valid[j] = True
             self.machine = _apply_page_writes(
                 self.machine, jnp.asarray(lanes), jnp.asarray(pfns),
-                jnp.asarray(pages), jnp.asarray(valid))
+                jnp.asarray(pages.view(np.uint64)), jnp.asarray(valid))
             view.pending.clear()
 
     # -- servicing ---------------------------------------------------------

@@ -43,7 +43,9 @@ from wtf_tpu.interp.uoptable import (
     F_OPC, F_OPSIZE, F_REP, F_SCALE, F_SEG, F_SEXT, F_SRCSIZE, F_SRC_KIND,
     F_SRC_REG, F_SUB, PROBES, UopTable,
 )
-from wtf_tpu.mem.overlay import ensure_page, gather_bytes, split_gpa
+from wtf_tpu.mem.overlay import (
+    extract_pair, load_window3, store_window3,
+)
 from wtf_tpu.mem.paging import translate
 from wtf_tpu.mem.physmem import MemImage
 
@@ -244,54 +246,25 @@ def _gpr_write(gpr, cond, idx, val, nbytes):
 
 # ---------------------------------------------------------------------------
 # memory spans (dynamic size <= 16 bytes, overlay-aware, two pages max)
+#
+# Word-window design: any <=16-byte span is covered by 3 aligned u64 words
+# (the page boundary is word-aligned, so each window word maps wholly to
+# one of the two translated pages).  Loads are 3 word gathers + shifts;
+# stores are a 3-word masked read-modify-write (mem/overlay.py).
 # ---------------------------------------------------------------------------
 
 def _load16(image, overlay, cr3, addr, size, need):
-    """Read up to 16 bytes at a GVA -> (u8[16], fault, t_first, t_last).
+    """Read up to 16 bytes at a GVA -> (lo, hi, fault, t_first).
 
-    `size` is a traced int32; bytes >= size carry garbage and must be masked
-    by the caller.  Fault only reported when `need`."""
+    `size` is a traced int32; bits >= size*8 carry garbage and must be
+    masked by the caller.  Fault only reported when `need`."""
     t0 = translate(image, overlay, cr3, addr)
     t1 = translate(image, overlay, cr3,
                    addr + (size - 1).astype(jnp.uint64))
     fault = need & ~(t0.ok & t1.ok)
-    off0 = (addr & _u(0xFFF)).astype(jnp.int32)
-    i = jnp.arange(16, dtype=jnp.int32)
-    on_first = (off0 + i) < 4096
-    iu = i.astype(jnp.uint64)
-    gpa = jnp.where(on_first, t0.gpa + iu,
-                    t1.gpa - (size - 1).astype(jnp.uint64) + iu)
-    data = gather_bytes(image, overlay, gpa, on_first)
-    return data, fault, t0, t1
-
-
-def _store16(image, overlay, t0, t1, addr, size, bytes16, enabled):
-    """Commit up to 16 bytes through the lane overlay (copy-on-write).
-
-    Uses translations computed earlier (so faults were already decided before
-    any state was committed).  Returns (overlay', ok); !ok = overlay full."""
-    pfn0, _ = split_gpa(image, t0.gpa)
-    pfn1, _ = split_gpa(image, t1.gpa)
-    off0 = (addr & _u(0xFFF)).astype(jnp.int32)
-    crosses = (off0 + size) > 4096
-    overlay, row0, ok0 = ensure_page(image, overlay, pfn0, enabled)
-    overlay, row1, ok1 = ensure_page(image, overlay, pfn1, enabled & crosses)
-    ok = ok0 & (ok1 | ~crosses)
-    i = jnp.arange(16, dtype=jnp.int32)
-    on_first = (off0 + i) < 4096
-    off = jnp.where(on_first, off0 + i, off0 + i - 4096)
-    row = jnp.where(on_first, row0, row1)
-    wmask = enabled & ok & (i < size)
-    cur = overlay.data[row, off]
-    data = overlay.data.at[row, off].set(
-        jnp.where(wmask, bytes16, cur))
-    return overlay._replace(data=data), ok
-
-
-def _pack_u64(b, start):
-    """Little-endian u64 from 8 bytes of a u8[16] window (static start)."""
-    sl = b[start:start + 8].astype(jnp.uint64)
-    return jnp.sum(sl << (jnp.arange(8, dtype=jnp.uint64) * _u(8)))
+    w0, w1, w2 = load_window3(image, overlay, t0.gpa, t1.gpa)
+    lo, hi = extract_pair(w0, w1, w2, t0.gpa)
+    return lo, hi, fault, t0
 
 
 def _bytes_of(lo, hi):
@@ -308,7 +281,10 @@ def _unpack_bytes(lo, hi):
 
 def _pack_pair(b16):
     """u8[16] -> (lo, hi) u64 pair."""
-    return _pack_u64(b16, 0), _pack_u64(b16, 8)
+    sh = jnp.arange(8, dtype=jnp.uint64) * _u(8)
+    lo = jnp.sum(b16[:8].astype(jnp.uint64) << sh)
+    hi = jnp.sum(b16[8:].astype(jnp.uint64) << sh)
+    return lo, hi
 
 # ---------------------------------------------------------------------------
 # the transition function
@@ -376,16 +352,16 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     # without a byte change is not detected (documented divergence — the
     # oracle flushes uops from dirtied pages the same way).
     code_off = (rip & _u(0xFFF)).astype(jnp.int32)
-    i16 = jnp.arange(16, dtype=jnp.int32)
-    on_first_c = (code_off + i16) < 4096
-    gpa_c = jnp.where(
-        on_first_c,
-        (tab.pfn0[idxc].astype(jnp.uint64) << _u(12)) + (code_off + i16).astype(jnp.uint64),
-        (tab.pfn1[idxc].astype(jnp.uint64) << _u(12)) + (code_off + i16 - 4096).astype(jnp.uint64),
-    )
-    code = gather_bytes(image, overlay, gpa_c, on_first_c)
-    code_lo = _pack_u64(code, 0)
-    code_hi = _pack_u64(code, 8)
+    code_crosses = (code_off + 16) > 4096
+    gpa_c0 = (tab.pfn0[idxc].astype(jnp.uint64) << _u(12)) \
+        + code_off.astype(jnp.uint64)
+    gpa_c15 = jnp.where(
+        code_crosses,
+        (tab.pfn1[idxc].astype(jnp.uint64) << _u(12))
+        + (code_off + 15 - 4096).astype(jnp.uint64),
+        gpa_c0 + _u(15))
+    cw0, cw1, cw2 = load_window3(image, overlay, gpa_c0, gpa_c15)
+    code_lo, code_hi = extract_pair(cw0, cw1, cw2, gpa_c0)
     lmask_lo = _size_mask(jnp.minimum(length, 8))
     lmask_hi = jnp.where(length > 8, _size_mask(length - 8), _u(0))
     smc = enabled & ~miss & ~at_bp & (
@@ -474,10 +450,10 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     l2_addr = jnp.where(s_cmps, rdi, ea)
     l2_size = opsize
 
-    b1, fault1, l1t0, _ = _load16(image, overlay, st.cr3, l1_addr, l1_size, l1_need)
-    b2, fault2, l2t0, _ = _load16(image, overlay, st.cr3, l2_addr, l2_size, l2_need)
-    l1_lo, l1_hi = _pack_u64(b1, 0), _pack_u64(b1, 8)
-    l2_lo = _pack_u64(b2, 0)
+    l1_lo, l1_hi, fault1, l1t0 = _load16(
+        image, overlay, st.cr3, l1_addr, l1_size, l1_need)
+    l2_lo, _, fault2, l2t0 = _load16(
+        image, overlay, st.cr3, l2_addr, l2_size, l2_need)
 
     # -- 4c. operand values ----------------------------------------------
     src_raw = jnp.where(sk == U.K_REG, _read_reg(gpr, sr, srcsize),
@@ -863,7 +839,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     bb = jnp.where(sk == U.K_XMM,
                    _unpack_bytes(xmm[jnp.clip(sr, 0, 15), 0],
                                  xmm[jnp.clip(sr, 0, 15), 1]),
-                   b1)
+                   _unpack_bytes(l1_lo, l1_hi))
     i16u = jnp.arange(16, dtype=jnp.int32)
     eq_b = (ba == bb)
     # word/dword equality via group-reduction of byte equality
@@ -1076,10 +1052,11 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_call, next_rip),
         (is_pushf, rf | _u(0x2)),
         (s_stos, rax_op),
+        (s_movs, l1_lo),
         (is_ssemov, xmm[jnp.clip(sr, 0, 15), 0]),
     ], _u(0))
-    st_hi = jnp.where(is_ssemov, xmm[jnp.clip(sr, 0, 15), 1], _u(0))
-    st_bytes = jnp.where(s_movs, b1, _bytes_of(st_lo, st_hi))
+    st_hi = jnp.where(is_ssemov, xmm[jnp.clip(sr, 0, 15), 1],
+                      jnp.where(s_movs, l1_hi, _u(0)))
 
     ts0 = translate(image, overlay, st.cr3, st_addr)
     ts1 = translate(image, overlay, st.cr3,
@@ -1089,8 +1066,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     page_fault = live & ~unsupported & ~is_crash & (fault1 | fault2 | store_fault)
     commit_pre = live & ~unsupported & ~is_crash & ~de & ~page_fault
 
-    overlay, store_ok = _store16(image, overlay, ts0, ts1, st_addr, st_size,
-                                 st_bytes, st_need & commit_pre)
+    overlay, store_ok = store_window3(image, overlay, ts0, ts1, st_size,
+                                      st_lo, st_hi, st_need & commit_pre)
     ovf = st_need & commit_pre & ~store_ok
     commit = commit_pre & ~ovf
 

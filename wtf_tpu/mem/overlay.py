@@ -10,13 +10,15 @@ from the shared HBM image into the lane's overlay slot, and every later
 read/write checks the overlay first.  `Restore()` is then a counter reset —
 no page data ever moves.
 
+Layout: page data is uint64 WORDS (little-endian), matching MemImage.  The
+hot primitives operate on aligned word windows — `pte_read` (1 word),
+`load_window3` (3 words cover any <=16-byte span), `store_window3` (3-word
+read-modify-write) — so a memory access costs a handful of word gathers
+instead of per-byte gathers.  Byte-granular `gather_bytes`/`scatter_span`
+remain for host-driven paths (testcase insertion, traces, tests).
+
 All functions here operate on a SINGLE lane's overlay and are `vmap`ped over
 the lane axis by the interpreter (MemImage broadcast, Overlay mapped).
-
-Memory accesses are at most `PAGE_SIZE` bytes, so they touch at most two
-pages.  The core primitives (`gather_bytes` / `scatter_bytes`) therefore take
-a per-byte GPA vector plus a boolean mask saying which of the two candidate
-pages (that of byte 0 / that of byte size-1) each byte belongs to.
 """
 
 from __future__ import annotations
@@ -27,19 +29,34 @@ import jax
 import jax.numpy as jnp
 
 from wtf_tpu.core.gxa import PAGE_SHIFT, PAGE_SIZE
-from wtf_tpu.mem.physmem import MemImage, frame_slot
+from wtf_tpu.mem.physmem import MemImage, PAGE_WORDS, frame_slot
 
 # pfn sentinel for "out of physical range" — never matches a stored pfn and
 # frame_slot() maps it to the zero page.  Plain int: module import must not
 # touch the device (jnp scalars would initialize the backend).
 _PFN_OOB = 0x7FFFFFFF
 
+_U64_MAX = (1 << 64) - 1
+
+
+def _u(x: int) -> jnp.ndarray:
+    return jnp.uint64(x & _U64_MAX)
+
+
+def _shl(x, s):
+    """x << s with s >= 64 yielding 0 (XLA leaves it undefined)."""
+    return jnp.where(s >= _u(64), _u(0), x << jnp.minimum(s, _u(63)))
+
+
+def _shr(x, s):
+    return jnp.where(s >= _u(64), _u(0), x >> jnp.minimum(s, _u(63)))
+
 
 class DirtyOverlay(NamedTuple):
     """One lane's dirty pages (batched: leading lane axis on every field)."""
 
     pfn: jax.Array       # int32[capacity]; -1 = free slot
-    data: jax.Array      # uint8[capacity, PAGE_SIZE]
+    data: jax.Array      # uint64[capacity, PAGE_WORDS]
     count: jax.Array     # int32 scalar: allocated slots
     overflow: jax.Array  # bool scalar: lane ran out of overlay slots
 
@@ -48,7 +65,7 @@ def overlay_init(n_lanes: int, capacity: int) -> DirtyOverlay:
     """Allocate the batched overlay store for `n_lanes` lanes."""
     return DirtyOverlay(
         pfn=jnp.full((n_lanes, capacity), -1, dtype=jnp.int32),
-        data=jnp.zeros((n_lanes, capacity, PAGE_SIZE), dtype=jnp.uint8),
+        data=jnp.zeros((n_lanes, capacity, PAGE_WORDS), dtype=jnp.uint64),
         count=jnp.zeros((n_lanes,), dtype=jnp.int32),
         overflow=jnp.zeros((n_lanes,), dtype=bool),
     )
@@ -113,6 +130,134 @@ def ensure_page(
     return DirtyOverlay(pfns, data, count, overflow), idx, ok
 
 
+def _read_word(image, overlay, slot, row, use_ov, word_idx):
+    """One overlay-aware aligned word."""
+    base = image.pages[slot, word_idx]
+    ov = overlay.data[row, word_idx]
+    return jnp.where(use_ov, ov, base)
+
+
+# ---------------------------------------------------------------------------
+# hot word-window primitives (the interpreter's memory path)
+# ---------------------------------------------------------------------------
+
+def pte_read(image: MemImage, overlay: DirtyOverlay, gpa: jax.Array) -> jax.Array:
+    """Read an 8-aligned little-endian u64 (page-table entries): exactly
+    one overlay lookup + two word gathers."""
+    pfn, off = split_gpa(image, gpa)
+    row, hit = lookup(overlay, pfn)
+    slot = frame_slot(image, pfn)
+    return _read_word(image, overlay, slot, row, hit, off >> 3)
+
+
+def load_window3(
+    image: MemImage,
+    overlay: DirtyOverlay,
+    gpa_first: jax.Array,  # translated GPA of the span's first byte
+    gpa_last: jax.Array,   # translated GPA of the span's last byte
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Three aligned words covering any <=16-byte virtual span (which may
+    straddle two discontiguous physical pages) -> (w0, w1, w2).
+
+    The window starts at the word containing the first byte; the page
+    boundary is word-aligned, so each window word belongs wholly to the
+    first or second page.  Callers extract values with shifts by
+    (gpa_first & 7) * 8."""
+    pfn0, off0 = split_gpa(image, gpa_first)
+    pfn1, _ = split_gpa(image, gpa_last)
+    row0, hit0 = lookup(overlay, pfn0)
+    row1, hit1 = lookup(overlay, pfn1)
+    slot0 = frame_slot(image, pfn0)
+    slot1 = frame_slot(image, pfn1)
+
+    w_start = off0 >> 3
+    words = []
+    for j in range(3):
+        on_first = (w_start + j) < PAGE_WORDS
+        widx = jnp.where(on_first, w_start + j, w_start + j - PAGE_WORDS)
+        slot = jnp.where(on_first, slot0, slot1)
+        row = jnp.where(on_first, row0, row1)
+        use_ov = jnp.where(on_first, hit0, hit1)
+        words.append(_read_word(image, overlay, slot, row, use_ov, widx))
+    return words[0], words[1], words[2]
+
+
+def extract_pair(w0, w1, w2, gpa_first):
+    """(lo, hi) u64 value pair of the 16 bytes starting at gpa_first,
+    from its 3-word window."""
+    sh = (gpa_first & _u(7)) * _u(8)
+    inv = _u(64) - sh
+    lo = _shr(w0, sh) | _shl(w1, inv)
+    hi = _shr(w1, sh) | _shl(w2, inv)
+    return lo, hi
+
+
+def store_window3(
+    image: MemImage,
+    overlay: DirtyOverlay,
+    t_first,               # Translation-like with .gpa of first byte
+    t_last,                # Translation-like with .gpa of last byte
+    size,                  # traced int32, 1..16
+    lo: jax.Array,
+    hi: jax.Array,
+    enabled: jax.Array,
+) -> Tuple[DirtyOverlay, jax.Array]:
+    """Commit up to 16 bytes (value (lo, hi), little-endian) through the
+    lane overlay: copy-on-write the one or two touched pages, then a
+    3-word read-modify-write with per-word bitmasks.  Returns
+    (overlay', ok); !ok = overlay full."""
+    pfn0, off0 = split_gpa(image, t_first.gpa)
+    pfn1, _ = split_gpa(image, t_last.gpa)
+    crosses = (off0 + size) > PAGE_SIZE
+    overlay, row0, ok0 = ensure_page(image, overlay, pfn0, enabled)
+    overlay, row1, ok1 = ensure_page(image, overlay, pfn1, enabled & crosses)
+    ok = ok0 & (ok1 | ~crosses)
+    do = enabled & ok
+
+    sh = (off0.astype(jnp.uint64) & _u(7)) * _u(8)
+    inv = _u(64) - sh
+    # value spread over the 3-word window
+    v0 = _shl(lo, sh)
+    v1 = _shr(lo, inv) | _shl(hi, sh)
+    v2 = _shr(hi, inv)
+    # bit span [sh, sh + size*8) within the 192-bit window
+    end_bit = sh + size.astype(jnp.uint64) * _u(8)
+
+    w_start = off0 >> 3
+    rows = []
+    widxs = []
+    news = []
+    for j, vj in enumerate((v0, v1, v2)):
+        on_first = (w_start + j) < PAGE_WORDS
+        widx = jnp.where(on_first, w_start + j, w_start + j - PAGE_WORDS)
+        row = jnp.where(on_first, row0, row1)
+        lo_bit = _u(64 * j)
+        # mask of the bits of word j inside the span [sh, end_bit)
+        start_in = jnp.maximum(sh, lo_bit)
+        end_in = jnp.minimum(end_bit, lo_bit + _u(64))
+        has = end_in > start_in
+        n_bits = jnp.where(has, end_in - start_in, _u(0))
+        off_in = jnp.where(has, start_in - lo_bit, _u(0))
+        # n_bits == 64 wraps (1 << 64 -> 0) to the all-ones mask, correct
+        mask = _shl(_shl(_u(1), n_bits) - _u(1), off_in)
+        old = overlay.data[row, widx]
+        new = jnp.where(do, (old & ~mask) | (vj & mask), old)
+        rows.append(row)
+        widxs.append(widx)
+        news.append(new)
+    # ONE scatter for all three words (the (row, widx) pairs are distinct
+    # by construction: word indices strictly increase within a page and
+    # the straddle moves to another row) — sequential single-word
+    # scatters would each materialize an overlay copy on some backends
+    data = overlay.data.at[jnp.stack(rows), jnp.stack(widxs)].set(
+        jnp.stack(news))
+    return overlay._replace(data=data), ok
+
+
+# ---------------------------------------------------------------------------
+# byte-granular compatibility paths (host-driven I/O, traces, tests)
+# ---------------------------------------------------------------------------
+
 def gather_bytes(
     image: MemImage,
     overlay: DirtyOverlay,
@@ -130,44 +275,72 @@ def gather_bytes(
     slot1 = frame_slot(image, pfn1)
 
     byte_off = (gpa_vec & jnp.uint64(PAGE_SIZE - 1)).astype(jnp.int32)
+    word_idx = byte_off >> 3
+    shift = ((byte_off & 7) * 8).astype(jnp.uint64)
     slot = jnp.where(first_mask, slot0, slot1)
     row = jnp.where(first_mask, idx0, idx1)
     use_ov = jnp.where(first_mask, hit0, hit1)
 
-    base_vals = image.pages[slot, byte_off]
-    ov_vals = overlay.data[row, byte_off]
-    return jnp.where(use_ov, ov_vals, base_vals).astype(jnp.uint8)
+    base_words = image.pages[slot, word_idx]
+    ov_words = overlay.data[row, word_idx]
+    words = jnp.where(use_ov, ov_words, base_words)
+    return ((words >> shift) & jnp.uint64(0xFF)).astype(jnp.uint8)
 
 
-def scatter_bytes(
+def scatter_span(
     image: MemImage,
     overlay: DirtyOverlay,
-    gpa_vec: jax.Array,    # uint64[size]
-    first_mask: jax.Array, # bool[size]
-    values: jax.Array,     # uint8[size]
+    gpa_first: jax.Array,  # translated GPA of the span's first byte
+    gpa_last: jax.Array,   # translated GPA of the span's last byte
+    values: jax.Array,     # uint8[size], a virtually-contiguous span
     enabled: jax.Array,    # bool scalar
 ) -> Tuple[DirtyOverlay, jax.Array]:
-    """Overlay-aware write over at most two physical pages -> (overlay', ok).
-
-    Every guest-visible write lands in the overlay and is therefore "dirty"
-    by construction — the device-side counterpart of the reference's
-    `VirtWriteDirty` contract (backend.cc:91-127).
-    """
-    size = gpa_vec.shape[0]
-    pfn0, _ = split_gpa(image, gpa_vec[0])
-    pfn1, _ = split_gpa(image, gpa_vec[size - 1])
+    """Overlay-aware write of a contiguous span over at most two physical
+    pages -> (overlay', ok).  Bytes are packed into aligned words and
+    committed with ONE collision-free word scatter.  Every guest-visible
+    write lands in the overlay and is therefore "dirty" by construction
+    (VirtWriteDirty, backend.cc:91-127)."""
+    size = values.shape[0]
+    pfn0, off0 = split_gpa(image, gpa_first)
+    pfn1, _ = split_gpa(image, gpa_last)
     two_pages = pfn1 != pfn0
 
     overlay, idx0, ok0 = ensure_page(image, overlay, pfn0, enabled)
     overlay, idx1, ok1 = ensure_page(image, overlay, pfn1, enabled & two_pages)
     ok = ok0 & jnp.where(two_pages, ok1, True)
+    do = enabled & ok
 
-    byte_off = (gpa_vec & jnp.uint64(PAGE_SIZE - 1)).astype(jnp.int32)
-    row = jnp.where(first_mask, idx0, jnp.where(two_pages, idx1, idx0))
-
-    current = overlay.data[row, byte_off]
-    new_vals = jnp.where(enabled & ok, values.astype(jnp.uint8), current)
-    data = overlay.data.at[row, byte_off].set(new_vals)
+    # pack bytes into the aligned word window [w_start, w_start + W)
+    head = (off0 & 7).astype(jnp.int32)
+    n_words = (int(size) + 7 + 7) // 8  # worst-case unaligned span
+    w_start = off0 >> 3
+    vals64 = values.astype(jnp.uint64)
+    rows, widxs, news = [], [], []
+    data = overlay.data
+    for j in range(n_words):
+        # byte indices of this word: i such that head + i in [8j, 8j+8)
+        i0 = 8 * j - head  # may be negative (traced)
+        k = jnp.arange(8, dtype=jnp.int32)
+        src = i0 + k
+        valid = (src >= 0) & (src < size)
+        src_c = jnp.clip(src, 0, size - 1)
+        word_val = jnp.sum(
+            jnp.where(valid, vals64[src_c], jnp.uint64(0))
+            << (k.astype(jnp.uint64) * jnp.uint64(8)))
+        mask = jnp.sum(
+            jnp.where(valid, jnp.uint64(0xFF), jnp.uint64(0))
+            << (k.astype(jnp.uint64) * jnp.uint64(8)))
+        on_first = (w_start + j) < PAGE_WORDS
+        widx = jnp.where(on_first, w_start + j, w_start + j - PAGE_WORDS)
+        row = jnp.where(on_first, idx0, jnp.where(two_pages, idx1, idx0))
+        old = data[row, widx]
+        rows.append(row)
+        widxs.append(widx)
+        news.append(jnp.where(do & (mask != 0),
+                              (old & ~mask) | (word_val & mask), old))
+    # one scatter: (row, widx) pairs are distinct (word indices strictly
+    # increase within each page; the straddle changes row)
+    data = data.at[jnp.stack(rows), jnp.stack(widxs)].set(jnp.stack(news))
     return overlay._replace(data=data), ok
 
 
@@ -195,13 +368,11 @@ def phys_write(
     enabled: jax.Array,
 ) -> Tuple[DirtyOverlay, jax.Array]:
     """Contiguous overlay-aware physical write (size <= PAGE_SIZE)."""
-    gpa_vec, first_mask = _contiguous_vec(gpa, values.shape[0])
-    return scatter_bytes(image, overlay, gpa_vec, first_mask, values, enabled)
+    last = gpa + jnp.uint64(values.shape[0] - 1)
+    return scatter_span(image, overlay, gpa, last, values, enabled)
 
 
 def phys_read_u64(image: MemImage, overlay: DirtyOverlay, gpa: jax.Array) -> jax.Array:
     """Read a little-endian u64 (used for page-table entries; PTEs are
-    8-aligned so this never crosses a page)."""
-    raw = phys_read(image, overlay, gpa, 8)
-    shifts = jnp.arange(8, dtype=jnp.uint64) * 8
-    return jnp.sum(raw.astype(jnp.uint64) << shifts)
+    8-aligned so this is a single word)."""
+    return pte_read(image, overlay, gpa)

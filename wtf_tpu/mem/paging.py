@@ -24,7 +24,7 @@ from wtf_tpu.mem.overlay import (
     DirtyOverlay,
     gather_bytes,
     phys_read_u64,
-    scatter_bytes,
+    scatter_span,
 )
 from wtf_tpu.mem.physmem import MemImage
 
@@ -141,9 +141,8 @@ def virt_write(
     fault = ~(first.ok & last.ok)
     if enforce_writable:
         fault = fault | ~(first.writable & last.writable)
-    gpa_vec, first_mask = _virt_byte_addrs(gva, size, first, last)
-    overlay, ok = scatter_bytes(
-        image, overlay, gpa_vec, first_mask, values, enabled & ~fault
+    overlay, ok = scatter_span(
+        image, overlay, first.gpa, last.gpa, values, enabled & ~fault
     )
     return overlay, fault | (enabled & ~fault & ~ok)
 

@@ -32,10 +32,20 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-class MemImage(NamedTuple):
-    """Device half of PhysMem; broadcast (unmapped) under vmap over lanes."""
+PAGE_WORDS = PAGE_SIZE // 8
 
-    pages: jax.Array       # uint8[slots, PAGE_SIZE]; slot 0 is the zero page
+
+class MemImage(NamedTuple):
+    """Device half of PhysMem; broadcast (unmapped) under vmap over lanes.
+
+    Pages are stored as little-endian uint64 WORDS, not bytes: the
+    interpreter's accesses (page-table entries, operand loads/stores,
+    code fetch) read aligned word windows and extract bytes with shifts,
+    cutting gather counts ~5-8x vs a byte-granular layout (a 16-byte
+    unaligned access is 3 word gathers instead of 16 byte gathers; a PTE
+    read is 1 instead of 8)."""
+
+    pages: jax.Array       # uint64[slots, PAGE_WORDS]; slot 0 = zero page
     frame_table: jax.Array # int32[nframes]; pfn -> slot (0 = absent/zero)
 
 
@@ -76,20 +86,21 @@ class PhysMem:
             present[pfn] = True
 
         image = MemImage(
-            pages=jnp.asarray(packed),
+            pages=jnp.asarray(packed.view(np.uint64)),  # LE word view
             frame_table=jnp.asarray(frame_table),
         )
         return cls(image=image, nframes=nframes, present=present)
 
     @property
     def nbytes(self) -> int:
-        return int(self.image.pages.size + self.image.frame_table.size * 4)
+        return int(self.image.pages.size * 8
+                   + self.image.frame_table.size * 4)
 
     def host_read(self, gpa: int, size: int) -> bytes:
         """Debug/host-side read of the *base* image (no overlay)."""
         if not hasattr(self, "_host_pages"):
             # Cache host copies once; the image is immutable after build.
-            self._host_pages = np.asarray(self.image.pages)
+            self._host_pages = np.asarray(self.image.pages).view(np.uint8)
             self._host_table = np.asarray(self.image.frame_table)
         out = bytearray()
         pos = gpa
