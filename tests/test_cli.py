@@ -76,6 +76,26 @@ def test_run_over_directory(tmp_path, capsys):
     assert (traces / "boom.trace").exists()
 
 
+def test_snapshot_conversion_roundtrip(tmp_path, capsys):
+    """npz -> dmp -> load -> run: the snapshot subcommand round-trips a
+    working guest through the Windows crash-dump format."""
+    from wtf_tpu.harness import demo_tlv
+
+    state_npz = tmp_path / "npz"
+    demo_tlv.build_snapshot().save_raw(state_npz)
+    rc = main(["snapshot", "--state", str(state_npz),
+               "--out", str(tmp_path / "dmp"), "--format", "dmp-bmp"])
+    assert rc == 0
+    assert (tmp_path / "dmp" / "mem.dmp").exists()
+    crash_file = tmp_path / "crash.bin"
+    crash_file.write_bytes(OVERFLOW)
+    rc = main(["run", "--name", "demo_tlv", "--backend", "emu",
+               "--state", str(tmp_path / "dmp"),
+               "--input", str(crash_file)])
+    assert rc == 2  # planted crash reproduces from the converted dump
+    assert "crash-" in capsys.readouterr().out
+
+
 def test_campaign_emu_finds_crash(tmp_path, capsys):
     rc = main(["campaign", "--name", "demo_tlv", "--backend", "emu",
                "--runs", "600", "--seed", "5", "--max_len", "128",

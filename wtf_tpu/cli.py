@@ -95,6 +95,15 @@ def build_parser() -> argparse.ArgumentParser:
     master.add_argument("--max_len", type=int, default=1024 * 1024)
     master.add_argument("--seed", type=int, default=0)
 
+    snap = sub.add_parser(
+        "snapshot", help="convert snapshots between formats")
+    snap.add_argument("--state", type=Path, required=True,
+                      help="input state dir (mem.npz or mem.dmp + regs.json)")
+    snap.add_argument("--out", type=Path, required=True,
+                      help="output state dir")
+    snap.add_argument("--format", choices=("npz", "dmp-bmp", "dmp-full"),
+                      default="npz")
+
     camp = sub.add_parser(
         "campaign", help="single-process fused master+node fuzz loop")
     _add_target_selection(camp)
@@ -264,6 +273,36 @@ def cmd_campaign(args) -> int:
     return 0 if stats.crashes == 0 else 2
 
 
+def cmd_snapshot(args) -> int:
+    """Format conversion: the bdump-side tooling the reference leaves to
+    external scripts.  npz <-> Windows crash dump both ways."""
+    import json
+
+    import numpy as np
+
+    from wtf_tpu.snapshot.kdmp import write_kdmp
+    from wtf_tpu.snapshot.loader import dump_cpu_state_json, load_snapshot
+
+    snap = load_snapshot(args.state)
+    args.out.mkdir(parents=True, exist_ok=True)
+    if args.format == "npz":
+        snap.save_raw(args.out)
+    else:
+        table = np.asarray(snap.physmem.image.frame_table)
+        page_data = np.asarray(snap.physmem.image.pages).view(np.uint8)
+        pages = {int(pfn): page_data[int(table[pfn])].tobytes()
+                 for pfn in np.nonzero(table)[0]}
+        write_kdmp(args.out / "mem.dmp", pages,
+                   dump_type="bmp" if args.format == "dmp-bmp" else "full",
+                   dtb=snap.cpu.cr3, cpu=snap.cpu)
+        (args.out / "regs.json").write_text(dump_cpu_state_json(snap.cpu))
+        (args.out / "symbol-store.json").write_text(json.dumps(
+            {k: hex(v) for k, v in snap.symbols.items()}, indent=1))
+    n_pages = int((np.asarray(snap.physmem.image.frame_table) != 0).sum())
+    print(f"wrote {args.format} snapshot ({n_pages} pages) to {args.out}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     driver = {
@@ -271,6 +310,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "fuzz": cmd_fuzz,
         "master": cmd_master,
         "campaign": cmd_campaign,
+        "snapshot": cmd_snapshot,
     }[args.subcommand]
     return driver(args)
 
