@@ -137,6 +137,40 @@ def test_fuzz_to_crash_single_client(tmp_path):
     assert parse_cov_files(tmp_path) == server.coverage
 
 
+def test_two_heterogeneous_clients(tmp_path):
+    """An emu node and a TPU batch node serve the same master
+    concurrently — the reference's N-processes-one-master shape with
+    mixed backend types (elasticity, server.h:534-544)."""
+    rng = random.Random(77)
+    corpus = Corpus(rng=rng)
+    corpus.add(BENIGN)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    crashes_dir=tmp_path / "crashes", runs=200)
+    thread = _serve(server, seconds=180)
+
+    emu_backend = create_backend("emu", demo_tlv.build_snapshot(),
+                                 limit=50_000)
+    emu_backend.initialize()
+    tpu_backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                                 n_lanes=4, limit=50_000)
+    tpu_backend.initialize()
+    node_a = Client(emu_backend, demo_tlv.TARGET, _addr(tmp_path))
+    node_b = BatchClient(tpu_backend, demo_tlv.TARGET, _addr(tmp_path))
+    t_a = threading.Thread(target=node_a.run)
+    t_a.start()
+    served_b = node_b.run()
+    t_a.join(timeout=180)
+    thread.join(timeout=180)
+    assert not thread.is_alive()
+    # both node types served work and the master accounted every run
+    # (crash discovery is asserted in the deterministic single-client
+    # test; two-client interleaving makes the mutation stream
+    # scheduling-dependent)
+    assert node_a.runs > 0 and served_b > 0
+    assert node_a.runs + served_b == server.stats.testcases == 200
+    assert len(server.coverage) > 0
+
+
 def test_batch_client_looks_like_n_nodes(tmp_path):
     """A TPU batch node is indistinguishable from n_lanes ordinary nodes:
     the master (unmodified) feeds it per-connection and aggregates per-lane
