@@ -1,0 +1,70 @@
+"""Hot-path ablation harness: time one interpreter chunk on the real chip
+across configs to localize the per-step cost (overlay probes vs uop-table
+gathers vs lane scaling).  Not part of the framework — a measurement tool.
+
+Usage: python ablate.py [config ...]; no args = all configs.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+CONFIGS = {
+    "base":      dict(n_lanes=1024, overlay_slots=128, uop_capacity=1 << 14),
+    "slots16":   dict(n_lanes=1024, overlay_slots=16,  uop_capacity=1 << 14),
+    "cap2k":     dict(n_lanes=1024, overlay_slots=128, uop_capacity=1 << 11),
+    "lanes256":  dict(n_lanes=256,  overlay_slots=128, uop_capacity=1 << 14),
+    "lanes4096": dict(n_lanes=4096, overlay_slots=128, uop_capacity=1 << 14),
+}
+
+
+def measure(name, cfg, chunk=512):
+    import jax.numpy as jnp
+
+    from wtf_tpu.harness import demo_tlv
+    from wtf_tpu.interp.runner import Runner, warm_decode_cache
+
+    snapshot = demo_tlv.build_snapshot()
+    r = Runner(snapshot, chunk_steps=chunk, **cfg)
+    payload = b"\x01\x08AAAAAAAA" * 200  # long branchy run: fills the chunk
+    warm_decode_cache(r, demo_tlv.TARGET, payload)
+    view = r.view()
+    for lane in range(cfg["n_lanes"]):
+        view.virt_write(lane, demo_tlv.INPUT_GVA, payload)
+        view.r["gpr"][lane, 2] = np.uint64(len(payload))
+    r.push(view)
+    tab = r.cache.device()
+    rc = r._run_chunk
+    t0 = time.time()
+    m = rc(tab, r.physmem.image, r.machine, jnp.uint64(1 << 40))
+    m.status.block_until_ready()
+    compile_s = time.time() - t0
+    ic0 = np.asarray(m.icount).copy()
+    t0 = time.time()
+    m2 = rc(tab, r.physmem.image, m, jnp.uint64(1 << 40))
+    m2.status.block_until_ready()
+    dt = time.time() - t0
+    instr = int((np.asarray(m2.icount) - ic0).sum())
+    print(json.dumps({
+        "config": name, **cfg, "chunk": chunk,
+        "compile_s": round(compile_s, 1),
+        "chunk_wall_s": round(dt, 4),
+        "per_step_ms": round(dt / chunk * 1e3, 3),
+        "instr_per_s": round(instr / dt, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    import faulthandler
+
+    faulthandler.dump_traceback_later(
+        int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
+    names = sys.argv[1:] or list(CONFIGS)
+    for n in names:
+        measure(n, CONFIGS[n])
+        faulthandler.cancel_dump_traceback_later()
+        faulthandler.dump_traceback_later(
+            int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")),
+            exit=True)

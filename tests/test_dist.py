@@ -160,6 +160,7 @@ def test_two_heterogeneous_clients(tmp_path):
     t_a.start()
     served_b = node_b.run()
     t_a.join(timeout=180)
+    assert not t_a.is_alive(), "emu client thread hung"
     thread.join(timeout=180)
     assert not thread.is_alive()
     # both node types served work and the master accounted every run

@@ -11,25 +11,43 @@ from wtf_tpu.core.results import Crash, Ok, Timedout
 from wtf_tpu.harness import demo_spin, demo_tlv
 
 
-def test_overlay_overflow_is_terminal_not_corrupting():
-    """A lane that dirties more pages than its overlay can hold parks as
-    a named crash; sibling lanes are unaffected."""
+def test_overlay_overflow_host_write_surfaces():
+    """A host write (testcase insertion path) that exceeds the lane's
+    overlay slots must surface as OVERLAY_FULL, not silently truncate;
+    sibling lanes are unaffected."""
+    from wtf_tpu.core.results import StatusCode
+
     backend = create_backend("tpu", demo_tlv.build_snapshot(),
                              n_lanes=2, limit=100_000, overlay_slots=4)
     backend.initialize()
+    runner = backend.runner
+    view = runner.view()
+    for i in range(5):  # 5 distinct stack pages > 4 slots
+        view.virt_write(0, demo_tlv.STACK_TOP - 0x1000 * (i + 2), b"\xCC" * 8)
+    runner.push(view)
+    statuses = runner.statuses()
+    assert statuses[0] == int(StatusCode.OVERLAY_FULL)
+    assert statuses[1] == int(StatusCode.RUNNING)
+
+
+def test_overlay_overflow_guest_store_is_terminal_not_corrupting():
+    """A lane whose guest stores need more pages than its overlay holds
+    parks as crash-overlay-full; siblings run; rerun is deterministic."""
+    backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                             n_lanes=2, limit=100_000, overlay_slots=2)
+    backend.initialize()
     demo_tlv.TARGET.init(backend)
-    # type-2 stores a qword at [r15]; the scratch page plus stack +
-    # input already cost slots, so a benign case still fits in 4 slots
-    results = backend.run_batch(
-        [b"\x02\x08AAAAAAAA", b"\x01\x02hi"], demo_tlv.TARGET)
-    assert all(not isinstance(r, Crash) or "overlay" in (r.name or "")
-               for r in results)
-    # whatever happened, restore + rerun is deterministic
+    # lane 0: input page + stack page + scratch store (type-2) = 3 pages
+    # > 2 slots; lane 1: empty input touches input + stack only = 2 pages
+    cases = [b"\x02\x08AAAAAAAA", b"\x01\x00"]
+    results = backend.run_batch(cases, demo_tlv.TARGET)
+    assert isinstance(results[0], Crash) and "overlay" in results[0].name, \
+        results[0]
+    assert isinstance(results[1], Ok), results[1]
     r1 = [str(r) for r in results]
     demo_tlv.TARGET.restore()
     backend.restore()
-    r2 = [str(r) for r in backend.run_batch(
-        [b"\x02\x08AAAAAAAA", b"\x01\x02hi"], demo_tlv.TARGET)]
+    r2 = [str(r) for r in backend.run_batch(cases, demo_tlv.TARGET)]
     assert r1 == r2
 
 

@@ -47,4 +47,16 @@ if _platforms and _platforms != "axon":
     except Exception:
         pass
 
+# Persistent XLA compilation cache: the interpreter step function is large
+# (~40-90s per compile on a 1-core host) and its shapes recur across
+# processes (bench reruns, CLI invocations, the driver's compile checks).
+# A user-provided JAX_COMPILATION_CACHE_DIR wins, like JAX_PLATFORMS above.
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/wtf_tpu_xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+
 __version__ = "0.1.0"

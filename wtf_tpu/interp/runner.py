@@ -313,7 +313,14 @@ def _apply_page_writes(machine: Machine, lanes, pfns, pages, valid):
                                 overflow=overflow), None
 
     overlay, _ = lax.scan(body, machine.overlay, (lanes, pfns, pages, valid))
-    return machine._replace(overlay=overlay)
+    # A host write that exceeded the lane's slots was dropped — surface the
+    # lane as OVERLAY_FULL instead of running on silently-truncated memory
+    # (the guest-store path surfaces the same way via step.py's `ovf`).
+    status = jnp.where(
+        overlay.overflow
+        & (machine.status == jnp.int32(int(StatusCode.RUNNING))),
+        jnp.int32(int(StatusCode.OVERLAY_FULL)), machine.status)
+    return machine._replace(overlay=overlay, status=status)
 
 
 class Runner:

@@ -41,12 +41,13 @@ from wtf_tpu.interp.machine import Machine
 from wtf_tpu.interp.uoptable import (
     F_BASE_REG, F_COND, F_DST_KIND, F_DST_REG, F_IDX_REG, F_LENGTH, F_LOCK,
     F_OPC, F_OPSIZE, F_REP, F_SCALE, F_SEG, F_SEXT, F_SRCSIZE, F_SRC_KIND,
-    F_SRC_REG, F_SUB, PROBES, UopTable,
+    F_SRC_REG, F_SUB, M_BP, M_PFN0, M_PFN1, MU_DISP, MU_IMM, MU_RAW_HI,
+    MU_RAW_LO, PROBES, UopTable,
 )
 from wtf_tpu.mem.overlay import (
-    extract_pair, load_window3, store_window3,
+    extract_pair, load_windows3_vec, store_window3,
 )
-from wtf_tpu.mem.paging import translate
+from wtf_tpu.mem.paging import Translation, translate_vec
 from wtf_tpu.mem.physmem import MemImage
 
 MASK64 = (1 << 64) - 1
@@ -253,20 +254,6 @@ def _gpr_write(gpr, cond, idx, val, nbytes):
 # stores are a 3-word masked read-modify-write (mem/overlay.py).
 # ---------------------------------------------------------------------------
 
-def _load16(image, overlay, cr3, addr, size, need):
-    """Read up to 16 bytes at a GVA -> (lo, hi, fault, t_first).
-
-    `size` is a traced int32; bits >= size*8 carry garbage and must be
-    masked by the caller.  Fault only reported when `need`."""
-    t0 = translate(image, overlay, cr3, addr)
-    t1 = translate(image, overlay, cr3,
-                   addr + (size - 1).astype(jnp.uint64))
-    fault = need & ~(t0.ok & t1.ok)
-    w0, w1, w2 = load_window3(image, overlay, t0.gpa, t1.gpa)
-    lo, hi = extract_pair(w0, w1, w2, t0.gpa)
-    return lo, hi, fault, t0
-
-
 def _bytes_of(lo, hi):
     sh = jnp.arange(8, dtype=jnp.uint64) * _u(8)
     b_lo = ((lo >> sh) & _u(0xFF)).astype(jnp.uint8)
@@ -292,16 +279,15 @@ def _pack_pair(b16):
 
 def uop_lookup(tab: UopTable, rip):
     """Open-addressed probe (host inserter bounds chains to PROBES) ->
-    entry index or -1 (NEED_DECODE)."""
+    entry index or -1 (NEED_DECODE).  All PROBES slots are fetched in one
+    gather pair (probe count is a latency, not a work, concern on TPU)."""
     hmask = _u(tab.hash_tab.shape[0] - 1)
     h = _splitmix64(rip)
-    idx = jnp.int32(-1)
-    for k in range(PROBES):
-        slot = ((h + _u(k)) & hmask).astype(jnp.int32)
-        e = tab.hash_tab[slot]
-        match = (e >= 0) & (tab.rip[jnp.maximum(e, 0)] == rip)
-        idx = jnp.where((idx < 0) & match, e, idx)
-    return idx
+    slots = ((h + jnp.arange(PROBES, dtype=jnp.uint64)) & hmask).astype(jnp.int32)
+    e = tab.hash_tab[slots]
+    match = (e >= 0) & (tab.rip[jnp.maximum(e, 0)] == rip)
+    first = jnp.argmax(match)
+    return jnp.where(jnp.any(match), e[first], jnp.int32(-1))
 
 
 def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
@@ -319,7 +305,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     miss = enabled & (idx < 0)
     idxc = jnp.maximum(idx, 0)
 
-    f = tab.fields[idxc]
+    f = tab.meta_i32[idxc]          # one row gather: fields + pfn0/pfn1/bp
+    mu = tab.meta_u64[idxc]         # one row gather: disp/imm/raw_lo/raw_hi
     opc = f[F_OPC]
     sub = f[F_SUB]
     cond = f[F_COND]
@@ -336,43 +323,45 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     scale = f[F_SCALE]
     seg = f[F_SEG]
     rep = f[F_REP]
-    disp = tab.disp[idxc]
-    imm = tab.imm[idxc]
+    disp = mu[MU_DISP]
+    imm = mu[MU_IMM]
 
     opmask = _size_mask(opsize)
     bits_u = opsize.astype(jnp.uint64) * _u(8)
     next_rip = rip + length.astype(jnp.uint64)
 
     # -- 2. breakpoint (pre-execution, like BeforeExecutionHook dispatch) --
-    at_bp = enabled & ~miss & (tab.bp[idxc] == 1) & (st.bp_skip == 0)
+    at_bp = enabled & ~miss & (f[M_BP] == 1) & (st.bp_skip == 0)
 
-    # -- 3. SMC check: live code bytes vs decode-time raw ----------------
+    # -- 3. SMC check addresses: live code bytes vs decode-time raw -------
     # Code physical frames come from the decode-time translation (pfn0/pfn1
     # table columns) so no page walk is needed for fetch; a *mapping* change
     # without a byte change is not detected (documented divergence — the
-    # oracle flushes uops from dirtied pages the same way).
+    # oracle flushes uops from dirtied pages the same way).  The window
+    # itself loads below, batched with the operand loads.
     code_off = (rip & _u(0xFFF)).astype(jnp.int32)
     code_crosses = (code_off + 16) > 4096
-    gpa_c0 = (tab.pfn0[idxc].astype(jnp.uint64) << _u(12)) \
+    gpa_c0 = (f[M_PFN0].astype(jnp.uint64) << _u(12)) \
         + code_off.astype(jnp.uint64)
     gpa_c15 = jnp.where(
         code_crosses,
-        (tab.pfn1[idxc].astype(jnp.uint64) << _u(12))
+        (f[M_PFN1].astype(jnp.uint64) << _u(12))
         + (code_off + 15 - 4096).astype(jnp.uint64),
         gpa_c0 + _u(15))
-    cw0, cw1, cw2 = load_window3(image, overlay, gpa_c0, gpa_c15)
-    code_lo, code_hi = extract_pair(cw0, cw1, cw2, gpa_c0)
-    lmask_lo = _size_mask(jnp.minimum(length, 8))
-    lmask_hi = jnp.where(length > 8, _size_mask(length - 8), _u(0))
-    smc = enabled & ~miss & ~at_bp & (
-        (((code_lo ^ tab.raw_lo[idxc]) & lmask_lo) != _u(0))
-        | (((code_hi ^ tab.raw_hi[idxc]) & lmask_hi) != _u(0)))
 
-    live = enabled & ~miss & ~at_bp & ~smc
+    # `live`'s final value needs the SMC verdict, which needs the batched
+    # window load; the predicates feeding address computation only need
+    # enabled/miss/bp (an SMC or about-to-fault lane computes garbage
+    # addresses whose loads are simply not `need`ed — same as before).
+    pre_live = enabled & ~miss & ~at_bp
 
-    # -- class predicates -------------------------------------------------
+    # -- class predicates (opc/fields only — stale for an SMC lane, but an
+    # SMC lane never commits: `live` below excludes it) -------------------
     def is_(o):
         return opc == o
+
+    opc_list = lambda pairs, default: jnp.select(  # noqa: E731
+        [p[0] for p in pairs], [p[1] for p in pairs], default=default)
 
     is_string = is_(U.OPC_STRING)
     s_movs = is_string & (sub == U.STR_MOVS)
@@ -398,13 +387,11 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     movcr_bad = is_(U.OPC_MOVCR) & ~(
         (sub == 0) | (sub == 3) | (sub == 4) | (sub == 8)
         | ((sext_f == 0) & (sub == 2)))
-    unsupported = live & (
+    unsupported = pre_live & (
         is_(U.OPC_INVALID) | is_(U.OPC_CPUID) | is_(U.OPC_IRET)
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
         | is_(U.OPC_STACKSTR) | (is_(U.OPC_RDGSBASE) & (sub != 4))
         | movcr_bad | div64_hard)
-
-    is_crash = live & (is_(U.OPC_INT) | is_(U.OPC_HLT) | is_(U.OPC_INT1))
 
     # -- 4a. effective address -------------------------------------------
     base_val = jnp.where(breg == U.REG_RIP, next_rip, _read64(gpr, breg))
@@ -428,32 +415,68 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     rsp, rbp, rsi, rdi = gpr[4], gpr[5], gpr[6], gpr[7]
     srcsize = jnp.where(srcsize0 == 0, opsize, srcsize0)
 
-    l1_need = live & ~unsupported & ~rep_skip & (
+    l1_need = pre_live & ~unsupported & ~rep_skip & (
         (sk == U.K_MEM) | is_pop | is_popf | is_ret | is_leave
         | s_movs | s_lods | s_cmps | s_scas)
     l1_addr = jnp.where(s_movs | s_lods | s_cmps, rsi,
                jnp.where(s_scas, rdi,
                 jnp.where(is_pop | is_popf | is_ret, rsp,
                  jnp.where(is_leave, rbp, ea))))
-    l1_size = jnp.where(is_popf | is_ret | is_leave, 16 // 2,
-               jnp.where(is_pop, opsize,
-                jnp.where(is_string, opsize,
-                 jnp.where(is_sse, opsize, srcsize))))
-    l1_size = jnp.where(is_popf | is_ret | is_leave, 8, l1_size)
+    l1_size = jnp.where(is_popf | is_ret | is_leave, 8,
+               jnp.where(is_pop | is_string | is_sse, opsize, srcsize))
 
     # store-only destinations (MOV/SETCC/POP write [mem] without reading it)
     # must NOT issue a dst-read load: their fault is the *store* fault, so
     # crash names report write access like the oracle's translate(write=True)
     store_only = is_(U.OPC_MOV) | is_(U.OPC_SETCC) | is_pop
-    l2_need = live & ~unsupported & ~rep_skip & (
+    l2_need = pre_live & ~unsupported & ~rep_skip & (
         ((dk == U.K_MEM) & ~is_sse & ~store_only) | s_cmps)
     l2_addr = jnp.where(s_cmps, rdi, ea)
     l2_size = opsize
 
-    l1_lo, l1_hi, fault1, l1t0 = _load16(
-        image, overlay, st.cr3, l1_addr, l1_size, l1_need)
-    l2_lo, _, fault2, l2t0 = _load16(
-        image, overlay, st.cr3, l2_addr, l2_size, l2_need)
+    # store address/size (the store itself commits at the end of the step;
+    # computing its span here lets its translation batch with the loads')
+    push_size = jnp.where(is_pushf | is_call, jnp.int32(8), opsize)
+    st_addr = opc_list([
+        (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
+        (s_movs | s_stos, rdi),
+    ], ea)
+    st_size = push_size  # stores and pushes span the same byte count
+
+    # -- 4b'. ONE vectorized page walk for all six translations, ONE
+    # batched gather for all three 16-byte windows (code/SMC, l1, l2).
+    # On TPU the step's cost is the count of unfusable gather kernels,
+    # so the walks and window reads are batched, not sequential.
+    gva6 = jnp.stack([
+        l1_addr, l1_addr + (l1_size - 1).astype(jnp.uint64),
+        l2_addr, l2_addr + (l2_size - 1).astype(jnp.uint64),
+        st_addr, st_addr + (st_size - 1).astype(jnp.uint64)])
+    t6 = translate_vec(image, overlay, st.cr3, gva6)
+
+    def _tr(i):
+        return Translation(gpa=t6.gpa[i], ok=t6.ok[i],
+                           writable=t6.writable[i], user=t6.user[i])
+
+    l1t0, l1t1, l2t0, l2t1, ts0, ts1 = (_tr(i) for i in range(6))
+    fault1 = l1_need & ~(l1t0.ok & l1t1.ok)
+    fault2 = l2_need & ~(l2t0.ok & l2t1.ok)
+
+    wf = jnp.stack([gpa_c0, l1t0.gpa, l2t0.gpa])
+    wl = jnp.stack([gpa_c15, l1t1.gpa, l2t1.gpa])
+    w3_0, w3_1, w3_2 = load_windows3_vec(image, overlay, wf, wl)
+    lo3, hi3 = extract_pair(w3_0, w3_1, w3_2, wf)
+    code_lo, code_hi = lo3[0], hi3[0]
+    l1_lo, l1_hi = lo3[1], hi3[1]
+    l2_lo = lo3[2]
+
+    # -- SMC verdict + the final live mask --------------------------------
+    lmask_lo = _size_mask(jnp.minimum(length, 8))
+    lmask_hi = jnp.where(length > 8, _size_mask(length - 8), _u(0))
+    smc = pre_live & (
+        (((code_lo ^ mu[MU_RAW_LO]) & lmask_lo) != _u(0))
+        | (((code_hi ^ mu[MU_RAW_HI]) & lmask_hi) != _u(0)))
+    live = pre_live & ~smc
+    is_crash = live & (is_(U.OPC_INT) | is_(U.OPC_HLT) | is_(U.OPC_INT1))
 
     # -- 4c. operand values ----------------------------------------------
     src_raw = jnp.where(sk == U.K_REG, _read_reg(gpr, sr, srcsize),
@@ -890,9 +913,6 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     i0, i1_, i2_, i4_, i5_, i11_ = (jnp.int32(0), jnp.int32(1), jnp.int32(2),
                                     jnp.int32(4), jnp.int32(5), jnp.int32(11))
 
-    opc_list = lambda pairs, default: jnp.select(  # noqa: E731
-        [p[0] for p in pairs], [p[1] for p in pairs], default=default)
-
     # primary register write (the generic `store_dst` reg case of emu.py)
     w1_cond = opc_list([
         (is_(U.OPC_MOV), dk == U.K_REG),
@@ -1003,8 +1023,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_XGETBV), jnp.int32(4)),
     ], opsize)
 
-    # rsp adjustment
-    push_size = jnp.where(is_pushf | is_call, jnp.int32(8), opsize)
+    # rsp adjustment (push_size computed with the store span, section 4b)
     w3_cond = is_push | is_pushf | is_call | is_pop | is_popf | is_ret | is_leave
     w3_val = opc_list([
         (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
@@ -1032,12 +1051,6 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     st_need = live & ~unsupported & ~rep_skip & (
         ((dk == U.K_MEM) & mem_class_writes)
         | is_push | is_pushf | is_call | s_movs | s_stos)
-    st_addr = opc_list([
-        (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
-        (s_movs | s_stos, rdi),
-    ], ea)
-    st_size = jnp.where(is_pushf | is_call, jnp.int32(8),
-                        jnp.where(is_push, opsize, opsize))
     st_lo = opc_list([
         (is_(U.OPC_MOV) | is_push, src_val),
         (is_(U.OPC_ALU), alu_r),
@@ -1058,9 +1071,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     st_hi = jnp.where(is_ssemov, xmm[jnp.clip(sr, 0, 15), 1],
                       jnp.where(s_movs, l1_hi, _u(0)))
 
-    ts0 = translate(image, overlay, st.cr3, st_addr)
-    ts1 = translate(image, overlay, st.cr3,
-                    st_addr + (st_size - 1).astype(jnp.uint64))
+    # store translations (ts0/ts1) come from the step's single batched walk
     store_fault = st_need & ~(ts0.ok & ts1.ok & ts0.writable & ts1.writable)
 
     page_fault = live & ~unsupported & ~is_crash & (fault1 | fault2 | store_fault)

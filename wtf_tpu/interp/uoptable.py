@@ -60,18 +60,25 @@ F_LOCK = _FIELD_INDEX["lock"]
 
 
 class UopTable(NamedTuple):
-    """Device arrays; broadcast (unmapped) under vmap over lanes."""
+    """Device arrays; broadcast (unmapped) under vmap over lanes.
 
-    rip: jax.Array       # uint64[capacity]
-    fields: jax.Array    # int32[capacity, NF]
-    disp: jax.Array      # uint64[capacity]
-    imm: jax.Array       # uint64[capacity]
-    raw_lo: jax.Array    # uint64[capacity]
-    raw_hi: jax.Array    # uint64[capacity]
-    pfn0: jax.Array      # int32[capacity]
-    pfn1: jax.Array      # int32[capacity]
-    bp: jax.Array        # int32[capacity]
+    Entry metadata is packed into TWO row-gatherable arrays (one int32, one
+    uint64) so fetching an instruction costs two gathers instead of nine —
+    on TPU the per-step cost is dominated by the count of unfusable gather
+    kernels, not their width."""
+
+    rip: jax.Array       # uint64[capacity] (probe verification)
+    meta_i32: jax.Array  # int32[capacity, NF + 3]: Uop fields, pfn0, pfn1, bp
+    meta_u64: jax.Array  # uint64[capacity, 4]: disp, imm, raw_lo, raw_hi
     hash_tab: jax.Array  # int32[hash_size]; entry index or -1
+
+
+# meta_i32 column layout (first NF columns are uops.INT_FIELDS)
+M_PFN0 = NF
+M_PFN1 = NF + 1
+M_BP = NF + 2
+# meta_u64 column layout
+MU_DISP, MU_IMM, MU_RAW_LO, MU_RAW_HI = 0, 1, 2, 3
 
 
 def _pack_raw(raw: bytes) -> Tuple[int, int]:
@@ -201,16 +208,15 @@ class DecodeCache:
     def device(self) -> UopTable:
         """Upload (or return cached) device arrays."""
         if self._device is None:
+            meta_i32 = np.concatenate(
+                [self.fields, self.pfn0[:, None], self.pfn1[:, None],
+                 self.bp[:, None]], axis=1)
+            meta_u64 = np.stack(
+                [self.disp, self.imm, self.raw_lo, self.raw_hi], axis=1)
             self._device = UopTable(
                 rip=jnp.asarray(self.rip),
-                fields=jnp.asarray(self.fields),
-                disp=jnp.asarray(self.disp),
-                imm=jnp.asarray(self.imm),
-                raw_lo=jnp.asarray(self.raw_lo),
-                raw_hi=jnp.asarray(self.raw_hi),
-                pfn0=jnp.asarray(self.pfn0),
-                pfn1=jnp.asarray(self.pfn1),
-                bp=jnp.asarray(self.bp),
+                meta_i32=jnp.asarray(meta_i32),
+                meta_u64=jnp.asarray(meta_u64),
                 hash_tab=jnp.asarray(self.hash_tab),
             )
         return self._device

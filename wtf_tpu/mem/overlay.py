@@ -101,6 +101,79 @@ def lookup(overlay: DirtyOverlay, pfn: jax.Array) -> Tuple[jax.Array, jax.Array]
     return idx, hit
 
 
+def lookup_vec(
+    overlay: DirtyOverlay, pfn_vec: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Find K pfns in this lane's overlay at once -> (idx[K], hit[K]).
+
+    One [K, capacity] compare + one row-wise argmax instead of K scalar
+    probes: the interpreter batches every overlay lookup a step needs into
+    a single call, cutting the per-step count of unfusable gather kernels
+    (the TPU cost is per-kernel dispatch latency, not the compares)."""
+    eq = overlay.pfn[None, :] == pfn_vec[:, None]
+    idx = jnp.argmax(eq, axis=1).astype(jnp.int32)
+    hit = jnp.any(eq, axis=1)  # gather-free: argmax picks the first True
+    return idx, hit
+
+
+def read_words_vec(
+    image: MemImage,
+    overlay: DirtyOverlay,
+    slot_vec: jax.Array,    # int32[K] image page slots
+    row_vec: jax.Array,     # int32[K] overlay rows
+    use_ov_vec: jax.Array,  # bool[K]
+    widx_vec: jax.Array,    # int32[K] word index within the page
+) -> jax.Array:
+    """K overlay-aware aligned words in two gathers (image + overlay)."""
+    base = image.pages[slot_vec, widx_vec]
+    ov = overlay.data[row_vec, widx_vec]
+    return jnp.where(use_ov_vec, ov, base)
+
+
+def pte_read_vec(
+    image: MemImage, overlay: DirtyOverlay, gpa_vec: jax.Array
+) -> jax.Array:
+    """K 8-aligned little-endian u64 reads (one page-walk level for every
+    translation a step needs) -> u64[K]."""
+    pfn, off = split_gpa(image, gpa_vec)
+    row, hit = lookup_vec(overlay, pfn)
+    slot = frame_slot(image, pfn)
+    return read_words_vec(image, overlay, slot, row, hit, off >> 3)
+
+
+def load_windows3_vec(
+    image: MemImage,
+    overlay: DirtyOverlay,
+    gpa_first_vec: jax.Array,  # uint64[K] first-byte GPA per window
+    gpa_last_vec: jax.Array,   # uint64[K] last-byte GPA per window
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """K 3-word windows (any <=16-byte span each) -> (w0[K], w1[K], w2[K]).
+
+    The batched form of `load_window3`: one overlay lookup over the 2K
+    page frames and one 3K-word gather pair, instead of K independent
+    window loads."""
+    k = gpa_first_vec.shape[0]
+    pfn0, off0 = split_gpa(image, gpa_first_vec)
+    pfn1, _ = split_gpa(image, gpa_last_vec)
+    rows, hits = lookup_vec(overlay, jnp.concatenate([pfn0, pfn1]))
+    row0, row1 = rows[:k], rows[k:]
+    hit0, hit1 = hits[:k], hits[k:]
+    slot0 = frame_slot(image, pfn0)
+    slot1 = frame_slot(image, pfn1)
+
+    w_start = off0 >> 3
+    j = jnp.arange(3, dtype=jnp.int32)[:, None]           # [3, 1]
+    on_first = (w_start[None, :] + j) < PAGE_WORDS        # [3, K]
+    widx = jnp.where(on_first, w_start[None, :] + j,
+                     w_start[None, :] + j - PAGE_WORDS)
+    slot = jnp.where(on_first, slot0[None, :], slot1[None, :])
+    row = jnp.where(on_first, row0[None, :], row1[None, :])
+    use_ov = jnp.where(on_first, hit0[None, :], hit1[None, :])
+    words = read_words_vec(image, overlay, slot.reshape(-1), row.reshape(-1),
+                           use_ov.reshape(-1), widx.reshape(-1)).reshape(3, k)
+    return words[0], words[1], words[2]
+
+
 def ensure_page(
     image: MemImage, overlay: DirtyOverlay, pfn: jax.Array, enabled: jax.Array
 ) -> Tuple[DirtyOverlay, jax.Array, jax.Array]:
@@ -130,56 +203,29 @@ def ensure_page(
     return DirtyOverlay(pfns, data, count, overflow), idx, ok
 
 
-def _read_word(image, overlay, slot, row, use_ov, word_idx):
-    """One overlay-aware aligned word."""
-    base = image.pages[slot, word_idx]
-    ov = overlay.data[row, word_idx]
-    return jnp.where(use_ov, ov, base)
-
-
 # ---------------------------------------------------------------------------
 # hot word-window primitives (the interpreter's memory path)
 # ---------------------------------------------------------------------------
 
 def pte_read(image: MemImage, overlay: DirtyOverlay, gpa: jax.Array) -> jax.Array:
-    """Read an 8-aligned little-endian u64 (page-table entries): exactly
-    one overlay lookup + two word gathers."""
-    pfn, off = split_gpa(image, gpa)
-    row, hit = lookup(overlay, pfn)
-    slot = frame_slot(image, pfn)
-    return _read_word(image, overlay, slot, row, hit, off >> 3)
+    """Read an 8-aligned little-endian u64 (page-table entries).  K=1
+    wrapper over `pte_read_vec` — one implementation of the overlay-aware
+    word read."""
+    return pte_read_vec(image, overlay,
+                        jnp.asarray(gpa, jnp.uint64).reshape(1))[0]
 
 
 def load_window3(
     image: MemImage,
     overlay: DirtyOverlay,
-    gpa_first: jax.Array,  # translated GPA of the span's first byte
-    gpa_last: jax.Array,   # translated GPA of the span's last byte
+    gpa_first: jax.Array,
+    gpa_last: jax.Array,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Three aligned words covering any <=16-byte virtual span (which may
-    straddle two discontiguous physical pages) -> (w0, w1, w2).
-
-    The window starts at the word containing the first byte; the page
-    boundary is word-aligned, so each window word belongs wholly to the
-    first or second page.  Callers extract values with shifts by
-    (gpa_first & 7) * 8."""
-    pfn0, off0 = split_gpa(image, gpa_first)
-    pfn1, _ = split_gpa(image, gpa_last)
-    row0, hit0 = lookup(overlay, pfn0)
-    row1, hit1 = lookup(overlay, pfn1)
-    slot0 = frame_slot(image, pfn0)
-    slot1 = frame_slot(image, pfn1)
-
-    w_start = off0 >> 3
-    words = []
-    for j in range(3):
-        on_first = (w_start + j) < PAGE_WORDS
-        widx = jnp.where(on_first, w_start + j, w_start + j - PAGE_WORDS)
-        slot = jnp.where(on_first, slot0, slot1)
-        row = jnp.where(on_first, row0, row1)
-        use_ov = jnp.where(on_first, hit0, hit1)
-        words.append(_read_word(image, overlay, slot, row, use_ov, widx))
-    return words[0], words[1], words[2]
+    """Scalar (K=1) convenience wrapper over `load_windows3_vec` — one
+    3-word window covering any <=16-byte span."""
+    w0, w1, w2 = load_windows3_vec(
+        image, overlay, gpa_first[None], gpa_last[None])
+    return w0[0], w1[0], w2[0]
 
 
 def extract_pair(w0, w1, w2, gpa_first):

@@ -24,6 +24,7 @@ from wtf_tpu.mem.overlay import (
     DirtyOverlay,
     gather_bytes,
     phys_read_u64,
+    pte_read_vec,
     scatter_span,
 )
 from wtf_tpu.mem.physmem import MemImage
@@ -56,18 +57,36 @@ def is_canonical(gva: jax.Array) -> jax.Array:
 def translate(
     image: MemImage, overlay: DirtyOverlay, cr3: jax.Array, gva: jax.Array
 ) -> Translation:
-    """Walk PML4 -> PDPT -> PD -> PT for one GVA (single lane; vmapped)."""
-    table = cr3 & PHYS_MASK
-    ok = is_canonical(gva)
-    writable = jnp.bool_(True)
-    user = jnp.bool_(True)
-    done = jnp.bool_(False)
-    gpa = jnp.uint64(0)
+    """Walk PML4 -> PDPT -> PD -> PT for one GVA (single lane; vmapped).
+
+    K=1 wrapper over `translate_vec` so the walk has exactly one
+    implementation (host-side reads and the device step cannot diverge)."""
+    t = translate_vec(image, overlay, cr3,
+                      jnp.asarray(gva, jnp.uint64).reshape(1))
+    return Translation(gpa=t.gpa[0], ok=t.ok[0],
+                       writable=t.writable[0], user=t.user[0])
+
+
+def translate_vec(
+    image: MemImage, overlay: DirtyOverlay, cr3: jax.Array, gva_vec: jax.Array
+) -> Translation:
+    """Walk K GVAs at once -> Translation with [K] fields.
+
+    Bit-identical to `translate` per element; the K walks share one
+    overlay lookup + PTE gather per level (the interpreter's six
+    translations per step collapse from 24 scalar PTE reads into 4
+    vectorized ones)."""
+    table = jnp.broadcast_to(cr3 & PHYS_MASK, gva_vec.shape)
+    ok = is_canonical(gva_vec)
+    writable = jnp.ones_like(ok)
+    user = jnp.ones_like(ok)
+    done = jnp.zeros_like(ok)
+    gpa = jnp.zeros_like(gva_vec)
 
     levels = ((39, None), (30, PHYS_MASK_1G), (21, PHYS_MASK_2M), (12, None))
     for shift, large_mask in levels:
-        index = (gva >> jnp.uint64(shift)) & jnp.uint64(0x1FF)
-        entry = phys_read_u64(image, overlay, table + index * jnp.uint64(8))
+        index = (gva_vec >> jnp.uint64(shift)) & jnp.uint64(0x1FF)
+        entry = pte_read_vec(image, overlay, table + index * jnp.uint64(8))
         present = (entry & PTE_PRESENT) != 0
         ok = ok & (done | present)
         writable = writable & (done | ((entry & PTE_WRITE) != 0))
@@ -76,11 +95,11 @@ def translate(
         if large_mask is not None:
             is_large = present & ((entry & PTE_PS) != 0) & ~done
             page_mask = (jnp.uint64(1) << jnp.uint64(shift)) - jnp.uint64(1)
-            large_gpa = (entry & large_mask) | (gva & page_mask)
+            large_gpa = (entry & large_mask) | (gva_vec & page_mask)
             gpa = jnp.where(is_large, large_gpa, gpa)
             done = done | is_large
         if shift == 12:
-            leaf_gpa = (entry & PHYS_MASK) | (gva & jnp.uint64(0xFFF))
+            leaf_gpa = (entry & PHYS_MASK) | (gva_vec & jnp.uint64(0xFFF))
             gpa = jnp.where(done, gpa, leaf_gpa)
 
         table = entry & PHYS_MASK
