@@ -445,15 +445,27 @@ def test_div128_host_fallback():
         assert int(g[lane, 2]) == emu.gpr[2]
 
 
-def test_cpuid_host_fallback():
-    asm = "mov eax, 0\ncpuid\nhlt"
+@pytest.mark.parametrize("leaf,subleaf", [
+    (0x0, 0),             # vendor string
+    (0x1, 0),             # feature bits
+    (0x9, 0),             # in-range basic leaf absent from the table
+    (0x1234, 0),          # out-of-range basic -> highest basic leaf
+    (0x40000000, 0),      # hypervisor range -> zeros
+    (0x80000001, 0),      # extended features
+    (0x1, 7),             # nonzero subleaf -> (leaf, 0) fallback
+])
+def test_cpuid_on_device(leaf, subleaf):
+    """CPUID executes on the device (no oracle fallback) and matches the
+    oracle's table + fallback chain for every class of leaf."""
+    asm = f"mov eax, {leaf:#x}\nmov ecx, {subleaf:#x}\ncpuid\nhlt"
     runner, status = run_tpu(asm, n_lanes=2)
     emu = run_emu(asm)
     g = np.asarray(runner.machine.gpr)
-    assert runner.stats["fallbacks"] >= 1
+    assert runner.stats["fallbacks"] == 0
     for lane in range(2):
         for reg in (0, 1, 2, 3):
-            assert int(g[lane, reg]) == emu.gpr[reg]
+            assert int(g[lane, reg]) == emu.gpr[reg], \
+                f"gpr{reg}: tpu={int(g[lane, reg]):#x} emu={emu.gpr[reg]:#x}"
 
 
 def test_coverage_bitmap_matches_unique_rips():

@@ -35,8 +35,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import numpy as np
+
 from wtf_tpu.core.results import StatusCode
 from wtf_tpu.cpu import uops as U
+from wtf_tpu.cpu.cpuid import CPUID_TABLE, MAX_BASIC_LEAF
 from wtf_tpu.interp.machine import Machine
 from wtf_tpu.interp.uoptable import (
     F_BASE_REG, F_COND, F_DST_KIND, F_DST_REG, F_IDX_REG, F_LENGTH, F_LOCK,
@@ -60,6 +63,14 @@ FLAGS_ARITH = _CF | _PF | _AF | _ZF | _SF | _OF  # 0x8D5
 
 def _u(x: int) -> jnp.ndarray:
     return jnp.uint64(x & MASK64)
+
+
+# Device copy of the oracle's CPUID model (cpu/cpuid.py): plain numpy at
+# module scope (must not touch the jax backend at import time); becomes a
+# compile-time constant of the traced step.
+_CPUID_KEYS = np.array([[l, s] for (l, s) in CPUID_TABLE], dtype=np.uint32)
+_CPUID_VALS = np.array([CPUID_TABLE[k] for k in CPUID_TABLE], dtype=np.uint32)
+_CPUID_BASIC_ROW = list(CPUID_TABLE).index((MAX_BASIC_LEAF, 0))
 
 
 def _mix64(z):
@@ -388,7 +399,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (sub == 0) | (sub == 3) | (sub == 4) | (sub == 8)
         | ((sext_f == 0) & (sub == 2)))
     unsupported = pre_live & (
-        is_(U.OPC_INVALID) | is_(U.OPC_CPUID) | is_(U.OPC_IRET)
+        is_(U.OPC_INVALID) | is_(U.OPC_IRET)
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
         | is_(U.OPC_STACKSTR) | (is_(U.OPC_RDGSBASE) & (sub != 4))
         | movcr_bad | div64_hard)
@@ -822,6 +833,24 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         default=rf)  # LAHF leaves rflags alone (writes AH instead)
     lahf_val = (rf & _u(0xD7)) | _u(0x2)
 
+    # CPUID: same table + fallback chain as the oracle (cpu/cpuid.py
+    # `cpuid()`): exact (leaf, subleaf), then (leaf, 0), then the highest
+    # basic leaf for out-of-range basic leaves, else zeros ---------------
+    cpuid_keys = jnp.asarray(_CPUID_KEYS)
+    cpuid_vals = jnp.asarray(_CPUID_VALS)
+    cp_eax = (gpr[0] & _u(0xFFFFFFFF)).astype(jnp.uint32)
+    cp_ecx = (gpr[1] & _u(0xFFFFFFFF)).astype(jnp.uint32)
+    cp_exact = (cpuid_keys[:, 0] == cp_eax) & (cpuid_keys[:, 1] == cp_ecx)
+    cp_leaf0 = (cpuid_keys[:, 0] == cp_eax) & (cpuid_keys[:, 1] == 0)
+    cp_in_basic_fb = ((cp_eax < jnp.uint32(0x80000000))
+                      & (cp_eax > jnp.uint32(MAX_BASIC_LEAF)))
+    cp_row = jnp.where(jnp.any(cp_exact), jnp.argmax(cp_exact),
+                       jnp.where(jnp.any(cp_leaf0), jnp.argmax(cp_leaf0),
+                                 _CPUID_BASIC_ROW))
+    cp_found = jnp.any(cp_exact) | jnp.any(cp_leaf0) | cp_in_basic_fb
+    cpuid_out = jnp.where(cp_found, cpuid_vals[cp_row],
+                          jnp.zeros(4, jnp.uint32)).astype(jnp.uint64)
+
     # RDTSC / RDRAND / XGETBV / SYSCALL / SWAPGS / MOVCR ---------------
     tsc_now = st.tsc + st.icount
     rdrand_next = _splitmix64(st.rdrand)
@@ -1093,6 +1122,12 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
                                           rcx_dec, new_gpr[1]))
     new_gpr = _gpr_write(new_gpr, commit & w2_cond, w2_idx, w2_val, w2_size)
     new_gpr = _gpr_write(new_gpr, commit & w1_cond, w1_idx, w1_val, w1_size)
+    # CPUID writes all four GPRs (32-bit, zero-extending), one more than
+    # the generic two-write router carries (oracle: emu.py OPC_CPUID)
+    cpw = commit & is_(U.OPC_CPUID)
+    for ridx, col in ((0, 0), (3, 1), (1, 2), (2, 3)):  # eax, ebx, ecx, edx
+        new_gpr = new_gpr.at[ridx].set(
+            jnp.where(cpw, cpuid_out[col], new_gpr[ridx]))
 
     # -- rflags ------------------------------------------------------------
     rf_exec = opc_list([

@@ -81,13 +81,25 @@ def replicate(tree, mesh: Mesh):
 def _or_reduce_lanes(words):
     """OR-reduce u32 bitmaps over the (possibly sharded) lane axis.
 
-    Formulated as bit-unpack -> jnp.any -> repack because XLA's cross-device
-    reduction set covers boolean OR but not u32 bitwise-or; jnp.any over a
-    sharded axis lowers to the ICI all-reduce we want."""
+    XLA's cross-device reduction set covers sum/min/max but not u32
+    bitwise-or, so a plain `bitwise_or.reduce` over a sharded axis fails
+    to partition.  Split the reduction instead: the expensive [L, W] part
+    is a shard-local bitwise OR (no collective, no expansion), and only
+    the tiny [W, 32] per-bit view crosses devices via `jnp.any`'s
+    boolean all-reduce.  (The former formulation expanded the full
+    [L, W, 32] bit tensor — 32x the bitmap bytes — before reducing.)"""
+    # lanes -> up to 64 groups; g is the largest power-of-two divisor of n
+    # (capped at 64), so it is a multiple of any power-of-two lane-mesh
+    # size <= g and each group's axis-1 OR stays shard-local; the final
+    # tiny any() over groups is the ICI collective.
+    n = words.shape[0]
+    g = min(n & -n, 64)
+    grouped = words.reshape(g, n // g, -1)
+    local = jnp.bitwise_or.reduce(grouped, axis=1)        # [g, W]
     shifts = jnp.arange(32, dtype=jnp.uint32)
-    bits = (words[..., None] >> shifts) & jnp.uint32(1)   # [L, W, 32]
-    any_bits = jnp.any(bits != 0, axis=0)                 # [W, 32]
-    return jnp.sum(any_bits.astype(jnp.uint32) << shifts, axis=-1)
+    bits = jnp.any((local[..., None] >> shifts) & jnp.uint32(1) != 0,
+                   axis=0)                                # [W, 32]
+    return jnp.sum(bits.astype(jnp.uint32) << shifts, axis=-1)
 
 
 @jax.jit
