@@ -154,6 +154,7 @@ def machine_restore(machine: Machine, snapshot_template: Machine) -> Machine:
         overlay=DirtyOverlay(
             pfn=jnp.full_like(machine.overlay.pfn, -1),
             data=machine.overlay.data,
+            valid=machine.overlay.valid,  # stale: cleared at reallocation
             count=jnp.zeros_like(machine.overlay.count),
             overflow=jnp.zeros_like(machine.overlay.overflow),
         ),

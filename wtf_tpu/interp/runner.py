@@ -115,10 +115,14 @@ class HostView:
         slots = np.nonzero(self._ov_pfn[lane] == pfn)[0]
         if len(slots) == 0:
             return None
-        data = self.runner.machine.overlay.data[lane, int(slots[0])]
-        # overlay rows are little-endian u64 words; tobytes() on a LE host
-        # yields the byte image
-        return np.asarray(data).tobytes()
+        slot = int(slots[0])
+        ov = self.runner.machine.overlay
+        data = np.asarray(ov.data[lane, slot])
+        valid = np.asarray(ov.valid[lane, slot])
+        # delta row: only valid words come from the overlay, the rest
+        # from the base image (little-endian words -> bytes on a LE host)
+        base = np.frombuffer(self._base_page(pfn), dtype=np.uint64)
+        return np.where(valid != 0, data, base).tobytes()
 
     def page(self, lane: int, pfn: int) -> bytes:
         """Current contents of a guest-physical page as this lane sees it."""
@@ -283,7 +287,7 @@ def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _apply_page_writes(machine: Machine, lanes, pfns, pages, valid):
+def _apply_page_writes(machine: Machine, lanes, pfns, pages, ok_mask):
     """Apply K buffered (lane, pfn, page) writes into the batched overlay in
     one device call (lax.scan; K is padded to a bucket size host-side).
 
@@ -303,16 +307,20 @@ def _apply_page_writes(machine: Machine, lanes, pfns, pages, valid):
         do = ok & (hit | can)
         data = overlay.data.at[lane, slot].set(
             jnp.where(do, page, overlay.data[lane, slot]))
+        # a whole-page host write makes every word of the delta row valid
+        valid = overlay.valid.at[lane, slot].set(
+            jnp.where(do, jnp.ones_like(overlay.valid[lane, slot]),
+                      overlay.valid[lane, slot]))
         pfn_new = overlay.pfn.at[lane, slot].set(
             jnp.where(do, pfn, overlay.pfn[lane, slot]).astype(jnp.int32))
         count = overlay.count.at[lane].add(
             jnp.where(ok & ~hit & can, 1, 0).astype(jnp.int32))
         overflow = overlay.overflow.at[lane].set(
             overlay.overflow[lane] | (ok & ~hit & ~can))
-        return overlay._replace(pfn=pfn_new, data=data, count=count,
-                                overflow=overflow), None
+        return overlay._replace(pfn=pfn_new, data=data, valid=valid,
+                                count=count, overflow=overflow), None
 
-    overlay, _ = lax.scan(body, machine.overlay, (lanes, pfns, pages, valid))
+    overlay, _ = lax.scan(body, machine.overlay, (lanes, pfns, pages, ok_mask))
     # A host write that exceeded the lane's slots was dropped — surface the
     # lane as OVERLAY_FULL instead of running on silently-truncated memory
     # (the guest-store path surfaces the same way via step.py's `ovf`).

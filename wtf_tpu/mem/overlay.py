@@ -53,10 +53,18 @@ def _shr(x, s):
 
 
 class DirtyOverlay(NamedTuple):
-    """One lane's dirty pages (batched: leading lane axis on every field)."""
+    """One lane's dirty pages (batched: leading lane axis on every field).
+
+    Rows are DELTAS, not copies: `valid[row, w]` marks the words of `data`
+    that have been written; reads take the overlay word when its valid
+    byte is set and the base image word otherwise.  Allocating a slot
+    therefore never copies the 4 KiB base page — the former copy-on-write
+    fill was the hot path's dominant memory traffic (16 KiB/lane/step on
+    store-heavy code)."""
 
     pfn: jax.Array       # int32[capacity]; -1 = free slot
     data: jax.Array      # uint64[capacity, PAGE_WORDS]
+    valid: jax.Array     # uint8[capacity, PAGE_WORDS]; 1 = word overlaid
     count: jax.Array     # int32 scalar: allocated slots
     overflow: jax.Array  # bool scalar: lane ran out of overlay slots
 
@@ -66,6 +74,7 @@ def overlay_init(n_lanes: int, capacity: int) -> DirtyOverlay:
     return DirtyOverlay(
         pfn=jnp.full((n_lanes, capacity), -1, dtype=jnp.int32),
         data=jnp.zeros((n_lanes, capacity, PAGE_WORDS), dtype=jnp.uint64),
+        valid=jnp.zeros((n_lanes, capacity, PAGE_WORDS), dtype=jnp.uint8),
         count=jnp.zeros((n_lanes,), dtype=jnp.int32),
         overflow=jnp.zeros((n_lanes,), dtype=bool),
     )
@@ -77,7 +86,8 @@ def overlay_reset(overlay: DirtyOverlay) -> DirtyOverlay:
     Replaces `Ram_t::Restore` + per-backend dirty loops (ram.h:235-280)."""
     return DirtyOverlay(
         pfn=jnp.full_like(overlay.pfn, -1),
-        data=overlay.data,  # stale data is unreachable once pfn is -1
+        data=overlay.data,   # stale data is unreachable once pfn is -1
+        valid=overlay.valid,  # stale too: cleared when a slot reallocates
         count=jnp.zeros_like(overlay.count),
         overflow=jnp.zeros_like(overlay.overflow),
     )
@@ -121,13 +131,15 @@ def read_words_vec(
     overlay: DirtyOverlay,
     slot_vec: jax.Array,    # int32[K] image page slots
     row_vec: jax.Array,     # int32[K] overlay rows
-    use_ov_vec: jax.Array,  # bool[K]
+    use_ov_vec: jax.Array,  # bool[K]: the page hit an overlay slot
     widx_vec: jax.Array,    # int32[K] word index within the page
 ) -> jax.Array:
-    """K overlay-aware aligned words in two gathers (image + overlay)."""
+    """K overlay-aware aligned words in three gathers (image + overlay
+    data + overlay word-validity)."""
     base = image.pages[slot_vec, widx_vec]
     ov = overlay.data[row_vec, widx_vec]
-    return jnp.where(use_ov_vec, ov, base)
+    ov_valid = overlay.valid[row_vec, widx_vec] != 0
+    return jnp.where(use_ov_vec & ov_valid, ov, base)
 
 
 def pte_read_vec(
@@ -177,7 +189,9 @@ def load_windows3_vec(
 def ensure_page(
     image: MemImage, overlay: DirtyOverlay, pfn: jax.Array, enabled: jax.Array
 ) -> Tuple[DirtyOverlay, jax.Array, jax.Array]:
-    """Make `pfn` resident in the overlay (copy-on-write) when `enabled`.
+    """Claim an overlay slot for `pfn` when `enabled` (delta semantics: the
+    row's data words are MEANINGLESS until their valid bytes are set by a
+    store — always read through `read_words_vec`, never `data` directly).
 
     Returns (overlay', slot index, ok).  ok=False when the overlay is full
     (the run loop surfaces that lane as a hard error) or pfn is out of range.
@@ -190,9 +204,11 @@ def ensure_page(
     do_alloc = enabled & ~hit & can_alloc & in_range
     idx = jnp.where(hit, idx0, overlay.count % capacity).astype(jnp.int32)
 
-    base = image.pages[frame_slot(image, pfn)]
-    new_row = jnp.where(do_alloc, base, overlay.data[idx])
-    data = overlay.data.at[idx].set(new_row)
+    # delta rows: allocation just claims the slot and clears its word
+    # validity (512 bytes) — no 4 KiB base-page copy
+    valid = overlay.valid.at[idx].set(
+        jnp.where(do_alloc, jnp.zeros(PAGE_WORDS, jnp.uint8),
+                  overlay.valid[idx]))
     pfns = overlay.pfn.at[idx].set(
         jnp.where(do_alloc, pfn, overlay.pfn[idx]).astype(jnp.int32)
     )
@@ -200,7 +216,7 @@ def ensure_page(
     overflow = overlay.overflow | (enabled & ~hit & ~can_alloc & in_range)
 
     ok = (hit | do_alloc) & in_range
-    return DirtyOverlay(pfns, data, count, overflow), idx, ok
+    return DirtyOverlay(pfns, overlay.data, valid, count, overflow), idx, ok
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +275,8 @@ def store_window3(
     overlay, row1, ok1 = ensure_page(image, overlay, pfn1, enabled & crosses)
     ok = ok0 & (ok1 | ~crosses)
     do = enabled & ok
+    slot0 = frame_slot(image, pfn0)
+    slot1 = frame_slot(image, pfn1)
 
     sh = (off0.astype(jnp.uint64) & _u(7)) * _u(8)
     inv = _u(64) - sh
@@ -273,10 +291,12 @@ def store_window3(
     rows = []
     widxs = []
     news = []
+    vnews = []
     for j, vj in enumerate((v0, v1, v2)):
         on_first = (w_start + j) < PAGE_WORDS
         widx = jnp.where(on_first, w_start + j, w_start + j - PAGE_WORDS)
         row = jnp.where(on_first, row0, row1)
+        slot = jnp.where(on_first, slot0, slot1)
         lo_bit = _u(64 * j)
         # mask of the bits of word j inside the span [sh, end_bit)
         start_in = jnp.maximum(sh, lo_bit)
@@ -286,18 +306,26 @@ def store_window3(
         off_in = jnp.where(has, start_in - lo_bit, _u(0))
         # n_bits == 64 wraps (1 << 64 -> 0) to the all-ones mask, correct
         mask = _shl(_shl(_u(1), n_bits) - _u(1), off_in)
-        old = overlay.data[row, widx]
-        new = jnp.where(do, (old & ~mask) | (vj & mask), old)
+        # delta rows: a partial write to a not-yet-valid word merges with
+        # the base image word; the stored word is then complete -> valid
+        was_valid = overlay.valid[row, widx] != 0
+        old = jnp.where(was_valid, overlay.data[row, widx],
+                        image.pages[slot, widx])
+        touched = do & (mask != _u(0))
+        new = jnp.where(touched, (old & ~mask) | (vj & mask), old)
         rows.append(row)
         widxs.append(widx)
         news.append(new)
+        vnews.append(jnp.where(touched, jnp.uint8(1),
+                               was_valid.astype(jnp.uint8)))
     # ONE scatter for all three words (the (row, widx) pairs are distinct
     # by construction: word indices strictly increase within a page and
     # the straddle moves to another row) — sequential single-word
     # scatters would each materialize an overlay copy on some backends
-    data = overlay.data.at[jnp.stack(rows), jnp.stack(widxs)].set(
-        jnp.stack(news))
-    return overlay._replace(data=data), ok
+    rows3, widxs3 = jnp.stack(rows), jnp.stack(widxs)
+    data = overlay.data.at[rows3, widxs3].set(jnp.stack(news))
+    valid = overlay.valid.at[rows3, widxs3].set(jnp.stack(vnews))
+    return overlay._replace(data=data, valid=valid), ok
 
 
 # ---------------------------------------------------------------------------
@@ -327,9 +355,7 @@ def gather_bytes(
     row = jnp.where(first_mask, idx0, idx1)
     use_ov = jnp.where(first_mask, hit0, hit1)
 
-    base_words = image.pages[slot, word_idx]
-    ov_words = overlay.data[row, word_idx]
-    words = jnp.where(use_ov, ov_words, base_words)
+    words = read_words_vec(image, overlay, slot, row, use_ov, word_idx)
     return ((words >> shift) & jnp.uint64(0xFF)).astype(jnp.uint8)
 
 
@@ -361,8 +387,9 @@ def scatter_span(
     n_words = (int(size) + 7 + 7) // 8  # worst-case unaligned span
     w_start = off0 >> 3
     vals64 = values.astype(jnp.uint64)
-    rows, widxs, news = [], [], []
-    data = overlay.data
+    slot0 = frame_slot(image, pfn0)
+    slot1 = frame_slot(image, pfn1)
+    rows, widxs, news, vnews = [], [], [], []
     for j in range(n_words):
         # byte indices of this word: i such that head + i in [8j, 8j+8)
         i0 = 8 * j - head  # may be negative (traced)
@@ -379,15 +406,24 @@ def scatter_span(
         on_first = (w_start + j) < PAGE_WORDS
         widx = jnp.where(on_first, w_start + j, w_start + j - PAGE_WORDS)
         row = jnp.where(on_first, idx0, jnp.where(two_pages, idx1, idx0))
-        old = data[row, widx]
+        slot = jnp.where(on_first, slot0, jnp.where(two_pages, slot1, slot0))
+        # delta rows: merge partial words with the base image word
+        was_valid = overlay.valid[row, widx] != 0
+        old = jnp.where(was_valid, overlay.data[row, widx],
+                        image.pages[slot, widx])
+        touched = do & (mask != 0)
         rows.append(row)
         widxs.append(widx)
-        news.append(jnp.where(do & (mask != 0),
+        news.append(jnp.where(touched,
                               (old & ~mask) | (word_val & mask), old))
+        vnews.append(jnp.where(touched, jnp.uint8(1),
+                               was_valid.astype(jnp.uint8)))
     # one scatter: (row, widx) pairs are distinct (word indices strictly
     # increase within each page; the straddle changes row)
-    data = data.at[jnp.stack(rows), jnp.stack(widxs)].set(jnp.stack(news))
-    return overlay._replace(data=data), ok
+    rws, wxs = jnp.stack(rows), jnp.stack(widxs)
+    data = overlay.data.at[rws, wxs].set(jnp.stack(news))
+    validmap = overlay.valid.at[rws, wxs].set(jnp.stack(vnews))
+    return overlay._replace(data=data, valid=validmap), ok
 
 
 def _contiguous_vec(gpa: jax.Array, size: int):

@@ -14,6 +14,7 @@ protocol (dist/), which needs no device awareness.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -78,22 +79,24 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), tree)
 
 
-def _or_reduce_lanes(words):
+def _or_reduce_lanes(words, groups: Optional[int]):
     """OR-reduce u32 bitmaps over the (possibly sharded) lane axis.
 
     XLA's cross-device reduction set covers sum/min/max but not u32
     bitwise-or, so a plain `bitwise_or.reduce` over a sharded axis fails
     to partition.  Split the reduction instead: the expensive [L, W] part
     is a shard-local bitwise OR (no collective, no expansion), and only
-    the tiny [W, 32] per-bit view crosses devices via `jnp.any`'s
+    the small [g, W, 32] per-bit view crosses devices via `jnp.any`'s
     boolean all-reduce.  (The former formulation expanded the full
-    [L, W, 32] bit tensor — 32x the bitmap bytes — before reducing.)"""
-    # lanes -> up to 64 groups; g is the largest power-of-two divisor of n
-    # (capped at 64), so it is a multiple of any power-of-two lane-mesh
-    # size <= g and each group's axis-1 OR stays shard-local; the final
-    # tiny any() over groups is the ICI collective.
+    [L, W, 32] bit tensor — 32x the bitmap bytes — before reducing.)
+
+    The group count must be a multiple of the lane-mesh size or the
+    "local" OR itself crosses shards; callers that hold the mesh pass
+    `groups` (merged_coverage's static arg).  The default — the largest
+    power-of-two divisor of n_lanes, capped at 256 — stays shard-local
+    for any power-of-two mesh up to 256 devices."""
     n = words.shape[0]
-    g = min(n & -n, 64)
+    g = groups if groups else min(n & -n, 256)
     grouped = words.reshape(g, n // g, -1)
     local = jnp.bitwise_or.reduce(grouped, axis=1)        # [g, W]
     shifts = jnp.arange(32, dtype=jnp.uint32)
@@ -102,10 +105,15 @@ def _or_reduce_lanes(words):
     return jnp.sum(bits.astype(jnp.uint32) << shifts, axis=-1)
 
 
-@jax.jit
-def merged_coverage(machine: Machine):
+@partial(jax.jit, static_argnames=("groups",))
+def merged_coverage(machine: Machine, groups: Optional[int] = None):
     """Batch-wide coverage union: OR-reduce the per-lane cov/edge bitmaps
     over the lane axis.  Under a sharded lane axis this lowers to an
     all-reduce over ICI — the device-side replacement for the reference
-    master's set-union merge (server.h:816-854)."""
-    return _or_reduce_lanes(machine.cov), _or_reduce_lanes(machine.edge)
+    master's set-union merge (server.h:816-854).
+
+    Pass `groups` = a multiple of the lane-mesh device count (e.g.
+    `mesh.size`) on meshes wider than 256 or with non-power-of-two
+    device counts; see `_or_reduce_lanes`."""
+    return (_or_reduce_lanes(machine.cov, groups),
+            _or_reduce_lanes(machine.edge, groups))
