@@ -495,3 +495,30 @@ def test_decoder_lengths_cover_stream():
         assert uop.length > 0
         pos += uop.length
     assert pos == len(code)
+
+
+IRETQ_ASM = """
+    lea r8, [rip + after]
+    mov r9, rsp
+    push 0x23
+    push r9
+    pushfq
+    pop r11
+    or r11, 0x400
+    push r11
+    push 0x33
+    push r8
+    iretq
+    ud2
+after:
+    mov rax, 0x17e7
+    hlt
+"""
+
+
+def test_iretq_returns_through_frame():
+    cpu = run_emu(IRETQ_ASM)
+    assert cpu.gpr[0] == 0x17e7          # landed at `after`
+    assert cpu.rflags & 0x400            # DF from the popped frame
+    # rsp restored from the frame (r9 captured it before the pushes)
+    assert cpu.gpr[4] == cpu.gpr[9]

@@ -488,3 +488,59 @@ def test_edge_bitmap_set_on_branches():
     runner, _ = run_tpu("jmp fwd\nnop\nfwd: hlt", n_lanes=1)
     edge = np.asarray(runner.machine.edge)[0]
     assert edge.sum() > 0
+
+
+def test_iretq_matches_oracle():
+    """iretq is serviced by the per-lane oracle fallback (UNSUPPORTED on
+    device, like the reference's bochs-backs-KVM split); end state must
+    match a pure-oracle run."""
+    from tests.test_emu import IRETQ_ASM
+
+    assert_matches_oracle(IRETQ_ASM)
+
+
+def test_rdmsr_wrmsr_match_oracle():
+    """rdmsr/wrmsr are serviced by the oracle fallback; MSR-backed fields
+    written there must round-trip (and reach the device mirror)."""
+    asm = """
+    mov ecx, 0xC0000082
+    rdmsr
+    shl rdx, 32
+    or rax, rdx
+    mov r12, rax
+    mov ecx, 0xC0000102
+    mov eax, 0x11223344
+    mov edx, 0x55667788
+    wrmsr
+    xor eax, eax
+    xor edx, edx
+    mov ecx, 0xC0000102
+    rdmsr
+    hlt
+    """
+    regs = {"lstar": 0xFFFFF00012345678}
+    runner, emu = assert_matches_oracle(asm, regs=regs)
+    assert emu.gpr[12] == 0xFFFFF00012345678          # rdmsr read lstar
+    assert emu.gpr[0] == 0x11223344                   # wrmsr round-trip lo
+    assert emu.gpr[2] == 0x55667788                   # hi
+    kgs = np.asarray(runner.machine.kernel_gs_base)
+    assert int(kgs[0]) == 0x5566778811223344          # device mirror updated
+
+
+def test_wrmsr_efer_persists_across_fallbacks():
+    """EFER is device-mirrored: a wrmsr through one oracle fallback must be
+    visible to a later rdmsr fallback (each fallback rebuilds the oracle
+    CPU from the mirror)."""
+    asm = """
+    mov ecx, 0xC0000080
+    rdmsr
+    or eax, 0x4000
+    wrmsr
+    xor eax, eax
+    xor edx, edx
+    mov ecx, 0xC0000080
+    rdmsr
+    hlt
+    """
+    runner, emu = assert_matches_oracle(asm)
+    assert emu.gpr[0] & 0x4000

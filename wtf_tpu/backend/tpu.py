@@ -278,7 +278,7 @@ class TpuBackend(Backend):
         cpu.rip = int(view.r["rip"][0])
         cpu.rflags = int(view.r["rflags"][0])
         for name in ("fs_base", "gs_base", "kernel_gs_base", "cr0", "cr3",
-                     "cr4", "cr8", "lstar", "star", "sfmask", "tsc"):
+                     "cr4", "cr8", "lstar", "star", "sfmask", "efer", "tsc"):
             setattr(cpu, name, int(view.r[name][0]))
         for i in range(16):
             cpu.xmm[i][0] = int(view.r["xmm"][0, i, 0])

@@ -28,10 +28,11 @@ from wtf_tpu.cpu.uops import (
     FL_STD, FL_STI, K_IMM, K_MEM, K_NONE, K_REG, K_XMM, MUL_2OP, MUL_WIDE_S,
     MUL_WIDE_U, OPC_ALU, OPC_BITSCAN, OPC_BSWAP, OPC_BT, OPC_CALL,
     OPC_CMOVCC, OPC_CMPXCHG, OPC_CONVERT, OPC_CPUID, OPC_DIV, OPC_FENCE,
-    OPC_FLAGOP, OPC_HLT, OPC_INT, OPC_INT1, OPC_INVALID, OPC_JCC, OPC_JMP,
+    OPC_FLAGOP, OPC_HLT, OPC_INT, OPC_INT1, OPC_INVALID, OPC_IRET, OPC_JCC,
+    OPC_JMP,
     OPC_LEA, OPC_LEAVE, OPC_MOV, OPC_MOVCR, OPC_MUL, OPC_NOP, OPC_PEXT,
     OPC_POP, OPC_RDGSBASE,
-    OPC_POPF, OPC_PUSH, OPC_PUSHF, OPC_RDRAND, OPC_RDTSC, OPC_RET,
+    OPC_MSR, OPC_POPF, OPC_PUSH, OPC_PUSHF, OPC_RDRAND, OPC_RDTSC, OPC_RET,
     OPC_SETCC, OPC_SHIFT, OPC_SSEALU, OPC_SSEMOV, OPC_STRING, OPC_SYSCALL,
     OPC_UNARY, OPC_XADD, OPC_XCHG, OPC_XGETBV, REG_AH_BASE, REG_NONE,
     REG_RIP, REP_NONE, REP_REP, REP_REPNE, SEG_FS, SEG_GS, SEG_NONE,
@@ -581,6 +582,10 @@ def _decode_primary(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
     if op == 0xCD:  # int imm8
         uop.opc, uop.sub = OPC_INT, cur.u8()
         return
+    if op == 0xCF:  # iret / iretq (REX.W): kernel-mode interrupt return
+        uop.opc = OPC_IRET
+        uop.opsize = 8 if pfx.rex_w else 4
+        return
 
     if op == 0xE3:  # jrcxz
         uop.opc, uop.cond = OPC_JCC, 16  # special cond: rcx == 0
@@ -748,6 +753,12 @@ def _decode_0f(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
 
     if op == 0x31:
         uop.opc = OPC_RDTSC
+        return
+    if op == 0x30:  # wrmsr
+        uop.opc, uop.sub = OPC_MSR, 1
+        return
+    if op == 0x32:  # rdmsr
+        uop.opc, uop.sub = OPC_MSR, 0
         return
     if op == 0xA2:
         uop.opc = OPC_CPUID

@@ -137,6 +137,7 @@ class EmuCpu:
         self.lstar = 0
         self.star = 0
         self.sfmask = 0
+        self.efer = 0
         self.tsc = 0
         self.icount = 0
         self.rdrand_state = 0
@@ -163,6 +164,7 @@ class EmuCpu:
         self.lstar = state.lstar
         self.star = state.star
         self.sfmask = state.sfmask
+        self.efer = state.efer
         self.tsc = state.tsc
         self.icount = 0
         self.rdrand_state = 0
@@ -475,6 +477,23 @@ class EmuCpu:
             self.rip = self.read_u(self.gpr[4], 8)
             self.gpr[4] = (self.gpr[4] + 8 + uop.imm) & MASK64
             return
+        elif opc == U.OPC_IRET:
+            # iretq: pop rip, cs, rflags, rsp, ss (five qwords).  Flat
+            # memory model: segment selectors are accepted but not acted
+            # on (the OS swapgs-es before iretq itself; privilege lives in
+            # the page tables here).  Reference gets this from bochs/KVM.
+            if uop.opsize != 8:
+                raise UnsupportedInsn(self.rip, uop.raw)  # iretd (no REX.W)
+            rsp = self.gpr[4]
+            new_rip = self.read_u(rsp, 8)
+            _cs = self.read_u(rsp + 8, 8)
+            new_rflags = self.read_u(rsp + 16, 8)
+            new_rsp = self.read_u(rsp + 24, 8)
+            _ss = self.read_u(rsp + 32, 8)
+            self.rip = new_rip
+            self.rflags = (new_rflags | 0x2) & 0x3C7FD7
+            self.gpr[4] = new_rsp & MASK64
+            return
         elif opc == U.OPC_JMP:
             self.rip = (next_rip + uop.imm) & MASK64 if uop.src_kind == U.K_IMM \
                 else load_src()
@@ -542,6 +561,29 @@ class EmuCpu:
             tsc = (self.tsc + self.icount) & MASK64
             self.write_reg(0, 8, tsc & 0xFFFFFFFF)
             self.write_reg(2, 8, tsc >> 32)
+        elif opc == U.OPC_MSR:
+            # rdmsr/wrmsr over the MSR-backed fields the snapshot carries
+            # (reference: bochs/KVM MSR state, kvm_backend.cc LoadMsrs)
+            msr_attr = {0x10: "tsc", 0xC0000080: "efer", 0xC0000081: "star",
+                        0xC0000082: "lstar", 0xC0000084: "sfmask",
+                        0xC0000100: "fs_base", 0xC0000101: "gs_base",
+                        0xC0000102: "kernel_gs_base"}
+            msr = self.gpr[1] & 0xFFFFFFFF
+            attr = msr_attr.get(msr)
+            if attr is None:
+                raise UnsupportedInsn(self.rip, uop.raw)
+            if uop.sub == 1:  # wrmsr: edx:eax
+                value = ((self.gpr[2] & 0xFFFFFFFF) << 32) \
+                    | (self.gpr[0] & 0xFFFFFFFF)
+                if attr == "tsc":  # keep rdtsc = tsc_base + icount coherent
+                    value = (value - self.icount) & MASK64
+                setattr(self, attr, value)
+            else:             # rdmsr -> edx:eax (32-bit zero-extending)
+                value = getattr(self, attr)
+                if attr == "tsc":
+                    value = (value + self.icount) & MASK64
+                self.write_reg(0, 8, value & 0xFFFFFFFF)
+                self.write_reg(2, 8, value >> 32)
         elif opc == U.OPC_RDRAND:
             self.rdrand_state = splitmix64(self.rdrand_state)
             store_dst(self.rdrand_state & mask)

@@ -84,8 +84,9 @@ OPC_SSECVT = 43    # scalar int<->float converts [minimal]
 OPC_PCLMUL = 44    # reserved
 OPC_PEXT = 45      # bmi: sub-op BMI_*
 OPC_STACKSTR = 46  # push/pop of segment etc (rare; unsupported)
+OPC_MSR = 47       # rdmsr/wrmsr (sub: 0 read, 1 write); oracle-serviced
 
-N_OPC = 47
+N_OPC = 48
 
 # ALU sub-ops (match x86 /r group encoding order, reference has the same
 # ordering baked into its emulator tables)

@@ -25,7 +25,7 @@ import numpy as np
 
 from wtf_tpu.core.cpustate import CpuState
 from wtf_tpu.core.results import StatusCode
-from wtf_tpu.mem.overlay import DirtyOverlay, overlay_init
+from wtf_tpu.mem.overlay import DirtyOverlay, overlay_init, overlay_reset
 
 
 class Machine(NamedTuple):
@@ -46,6 +46,7 @@ class Machine(NamedTuple):
     lstar: jax.Array      # uint64[L]
     star: jax.Array       # uint64[L]
     sfmask: jax.Array     # uint64[L]
+    efer: jax.Array       # uint64[L]
     tsc: jax.Array        # uint64[L]
 
     # Run bookkeeping
@@ -78,7 +79,7 @@ def cpu_vector(cpu: CpuState) -> np.ndarray:
         + [
             cpu.rip, cpu.rflags | 0x2, cpu.fs.base, cpu.gs.base,
             cpu.kernel_gs_base, cpu.cr0, cpu.cr3, cpu.cr4, cpu.cr8,
-            cpu.lstar, cpu.star, cpu.sfmask, cpu.tsc,
+            cpu.lstar, cpu.star, cpu.sfmask, cpu.efer, cpu.tsc,
         ],
         dtype=np.uint64,
     )
@@ -118,6 +119,7 @@ def machine_init(
         lstar=bcast(cpu.lstar),
         star=bcast(cpu.star),
         sfmask=bcast(cpu.sfmask),
+        efer=bcast(cpu.efer),
         tsc=bcast(cpu.tsc),
         status=jnp.full((n_lanes,), int(StatusCode.RUNNING), dtype=jnp.int32),
         icount=jnp.zeros((n_lanes,), dtype=jnp.uint64),
@@ -150,14 +152,8 @@ def machine_restore(machine: Machine, snapshot_template: Machine) -> Machine:
     machine freely."""
     return snapshot_template._replace(
         # Keep the overlay *storage* from the live machine so no new buffers
-        # are allocated; reset just the indexing state.
-        overlay=DirtyOverlay(
-            pfn=jnp.full_like(machine.overlay.pfn, -1),
-            data=machine.overlay.data,
-            valid=machine.overlay.valid,  # stale: cleared at reallocation
-            count=jnp.zeros_like(machine.overlay.count),
-            overflow=jnp.zeros_like(machine.overlay.overflow),
-        ),
+        # are allocated; overlay_reset rebuilds just the indexing state.
+        overlay=overlay_reset(machine.overlay),
         cov=jnp.zeros_like(machine.cov),
         edge=jnp.zeros_like(machine.edge),
     )

@@ -53,7 +53,7 @@ PHYS_MASK = 0x000F_FFFF_FFFF_F000
 # Machine leaves mirrored into HostView (everything except overlay/cov/edge).
 _MIRROR_FIELDS = (
     "gpr", "rip", "rflags", "xmm", "fs_base", "gs_base", "kernel_gs_base",
-    "cr0", "cr3", "cr4", "cr8", "lstar", "star", "sfmask", "tsc",
+    "cr0", "cr3", "cr4", "cr8", "lstar", "star", "sfmask", "efer", "tsc",
     "status", "icount", "rdrand", "bp_skip", "fault_gva", "fault_write",
 )
 
@@ -263,6 +263,7 @@ def _lane_cpu_state(view: HostView, lane: int, snapshot_cpu: CpuState) -> CpuSta
     cpu.lstar = int(view.r["lstar"][lane])
     cpu.star = int(view.r["star"][lane])
     cpu.sfmask = int(view.r["sfmask"][lane])
+    cpu.efer = int(view.r["efer"][lane])
     cpu.tsc = int(view.r["tsc"][lane])
     for i in range(16):
         cpu.zmm[i][0] = int(view.r["xmm"][lane, i, 0])
@@ -281,6 +282,12 @@ def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
     view.r["cr3"][lane] = np.uint64(cpu.cr3 & MASK64)
     view.r["cr4"][lane] = np.uint64(cpu.cr4 & MASK64)
     view.r["cr8"][lane] = np.uint64(cpu.cr8 & MASK64)
+    # MSR-backed fields a wrmsr fallback may have rewritten
+    view.r["lstar"][lane] = np.uint64(cpu.lstar & MASK64)
+    view.r["star"][lane] = np.uint64(cpu.star & MASK64)
+    view.r["sfmask"][lane] = np.uint64(cpu.sfmask & MASK64)
+    view.r["efer"][lane] = np.uint64(cpu.efer & MASK64)
+    view.r["tsc"][lane] = np.uint64(cpu.tsc & MASK64)
     for i in range(16):
         view.r["xmm"][lane, i, 0] = np.uint64(cpu.xmm[i][0] & MASK64)
         view.r["xmm"][lane, i, 1] = np.uint64(cpu.xmm[i][1] & MASK64)

@@ -399,7 +399,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (sub == 0) | (sub == 3) | (sub == 4) | (sub == 8)
         | ((sext_f == 0) & (sub == 2)))
     unsupported = pre_live & (
-        is_(U.OPC_INVALID) | is_(U.OPC_IRET)
+        is_(U.OPC_INVALID) | is_(U.OPC_IRET) | is_(U.OPC_MSR)
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
         | is_(U.OPC_STACKSTR) | (is_(U.OPC_RDGSBASE) & (sub != 4))
         | movcr_bad | div64_hard)
