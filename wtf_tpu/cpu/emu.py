@@ -30,6 +30,13 @@ from wtf_tpu.mem.physmem import PhysMem
 
 MASK64 = (1 << 64) - 1
 
+# MSR number -> EmuCpu attribute for the rdmsr/wrmsr subset the snapshot
+# carries (reference: bochs/KVM MSR state, kvm_backend.cc LoadMsrs)
+MSR_ATTR = {0x10: "tsc", 0xC0000080: "efer", 0xC0000081: "star",
+            0xC0000082: "lstar", 0xC0000084: "sfmask",
+            0xC0000100: "fs_base", 0xC0000101: "gs_base",
+            0xC0000102: "kernel_gs_base"}
+
 PTE_P = 1
 PTE_W = 1 << 1
 PTE_PS = 1 << 7
@@ -491,7 +498,7 @@ class EmuCpu:
             new_rsp = self.read_u(rsp + 24, 8)
             _ss = self.read_u(rsp + 32, 8)
             self.rip = new_rip
-            self.rflags = (new_rflags | 0x2) & 0x3C7FD7
+            self.rflags = (new_rflags | 0x2) & U.RF_WRITABLE
             self.gpr[4] = new_rsp & MASK64
             return
         elif opc == U.OPC_JMP:
@@ -564,12 +571,8 @@ class EmuCpu:
         elif opc == U.OPC_MSR:
             # rdmsr/wrmsr over the MSR-backed fields the snapshot carries
             # (reference: bochs/KVM MSR state, kvm_backend.cc LoadMsrs)
-            msr_attr = {0x10: "tsc", 0xC0000080: "efer", 0xC0000081: "star",
-                        0xC0000082: "lstar", 0xC0000084: "sfmask",
-                        0xC0000100: "fs_base", 0xC0000101: "gs_base",
-                        0xC0000102: "kernel_gs_base"}
             msr = self.gpr[1] & 0xFFFFFFFF
-            attr = msr_attr.get(msr)
+            attr = MSR_ATTR.get(msr)
             if attr is None:
                 raise UnsupportedInsn(self.rip, uop.raw)
             if uop.sub == 1:  # wrmsr: edx:eax
@@ -607,7 +610,7 @@ class EmuCpu:
                 return
             else:  # sysret
                 self.rip = self.gpr[1]
-                self.rflags = (self.gpr[11] & 0x3C7FD7) | 0x2
+                self.rflags = (self.gpr[11] & U.RF_WRITABLE) | 0x2
                 return
         elif opc == U.OPC_RDGSBASE:
             if uop.sub == 4:  # swapgs

@@ -88,6 +88,11 @@ OPC_MSR = 47       # rdmsr/wrmsr (sub: 0 read, 1 write); oracle-serviced
 
 N_OPC = 48
 
+# RFLAGS bits writable by flag-image restores (sysret r11, iretq frame):
+# CF PF AF ZF SF TF IF DF OF IOPL NT RF VM AC VIF VIP ID minus the
+# reserved/always-set positions.
+RF_WRITABLE = 0x3C7FD7
+
 # ALU sub-ops (match x86 /r group encoding order, reference has the same
 # ordering baked into its emulator tables)
 ALU_ADD, ALU_OR, ALU_ADC, ALU_SBB, ALU_AND, ALU_SUB, ALU_XOR, ALU_CMP = range(8)

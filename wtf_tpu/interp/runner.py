@@ -80,11 +80,18 @@ class HostView:
     def __init__(self, runner: "Runner"):
         self.runner = runner
         m = runner.machine
+        # ONE batched device->host transfer for all mirrored leaves (a
+        # per-field pull costs a device round trip each — 22 RPCs per
+        # servicing round over a remote-TPU tunnel)
+        host = jax.device_get(
+            {name: getattr(m, name) for name in _MIRROR_FIELDS}
+            | {"__ov_pfn": m.overlay.pfn})
+        # np.array: device_get may hand back read-only views; handlers mutate
         self.r: Dict[str, np.ndarray] = {
-            name: np.array(getattr(m, name)) for name in _MIRROR_FIELDS
+            name: np.array(host[name]) for name in _MIRROR_FIELDS
         }
         # overlay index pulled once; data rows fetched lazily per (lane, pfn)
-        self._ov_pfn = np.asarray(m.overlay.pfn)
+        self._ov_pfn = host["__ov_pfn"]
         self._page_cache: Dict[Tuple[int, int], bytes] = {}
         self.pending: Dict[Tuple[int, int], bytearray] = {}
 

@@ -856,7 +856,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     rdrand_next = _splitmix64(st.rdrand)
     rdrand_rf = (rf & ~_u(FLAGS_ARITH)) | _u(_CF)
     syscall_rf = (rf & ~(st.sfmask | _u(_TF))) | _u(0x2)
-    sysret_rf = (gpr[11] & _u(0x3C7FD7)) | _u(0x2)
+    sysret_rf = (gpr[11] & _u(U.RF_WRITABLE)) | _u(0x2)
     cr_read = jnp.select(
         [sub == 0, sub == 2, sub == 3, sub == 4, sub == 8],
         [st.cr0, _u(0), st.cr3, st.cr4, st.cr8], default=_u(0))
