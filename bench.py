@@ -51,7 +51,7 @@ def worker() -> None:
     import jax
 
     platform = jax.devices()[0].platform
-    n_lanes = int(os.environ.get("BENCH_LANES", "1024"))
+    n_lanes = int(os.environ.get("BENCH_LANES", "4096"))
     seconds = float(os.environ.get("BENCH_SECONDS", "20"))
     if platform == "cpu":
         # degraded mode: a 1-core host can't drive wide batches; keep the
@@ -59,9 +59,27 @@ def worker() -> None:
         n_lanes = min(n_lanes, 128)
 
     snapshot = demo_tlv.build_snapshot()
-    backend = create_backend("tpu", snapshot, n_lanes=n_lanes,
-                             limit=100_000, chunk_steps=512)
-    backend.initialize()
+    # lanes are the throughput axis (per-step wall is kernel-latency
+    # dominated, PERF.md); start wide and halve on allocation failure
+    backend = None
+    while True:
+        try:
+            backend = create_backend("tpu", snapshot, n_lanes=n_lanes,
+                                     limit=100_000, chunk_steps=512,
+                                     overlay_slots=32)
+            backend.initialize()
+            break
+        except Exception as e:  # noqa: BLE001
+            # only allocation pressure justifies shrinking the batch; any
+            # other failure re-raises (the supervisor handles retries)
+            msg = f"{type(e).__name__}: {e}"
+            oom = ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                   or "out of memory" in msg)
+            if not oom or n_lanes <= 128:
+                raise
+            print(f"bench: {n_lanes} lanes OOM, halving ({msg[:120]})",
+                  file=sys.stderr)
+            n_lanes //= 2
     demo_tlv.TARGET.init(backend)
 
     rng = random.Random(0x77F)

@@ -40,6 +40,10 @@ def test_adaptive_chunks_reduce_round_trips():
     for adaptive in (False, True):
         backend = make_backend("tpu", n_lanes=4, chunk_steps=64)
         backend.runner.adaptive_chunks = adaptive
+        # cap growth at 1024 steps: proves the adaptive win without paying
+        # the 16384-step chunk's XLA compile in CI (growth to 65536 is the
+        # same code path, exercised by campaigns)
+        backend.runner._chunk_sizes = [64, 1024]
         res = backend.run_batch([spin(3000)] * 4, ds.TARGET)
         assert all(isinstance(r, Ok) for r in res)
         results[adaptive] = (

@@ -89,8 +89,11 @@ OPC_MSR = 47       # rdmsr/wrmsr (sub: 0 read, 1 write); oracle-serviced
 N_OPC = 48
 
 # RFLAGS bits writable by flag-image restores (sysret r11, iretq frame):
-# CF PF AF ZF SF TF IF DF OF IOPL NT RF VM AC VIF VIP ID minus the
-# reserved/always-set positions.
+# CF PF AF ZF SF TF IF DF OF IOPL NT AC VIF VIP ID.  RF (bit 16) and VM
+# (bit 17) are intentionally masked — this is sysret's architectural
+# 0x3C7FD7 mask, which we also apply to iretq (hardware iretq restores
+# RF; this framework never single-steps via RF, so the difference is
+# unobservable to guests and keeps one shared mask).
 RF_WRITABLE = 0x3C7FD7
 
 # ALU sub-ops (match x86 /r group encoding order, reference has the same
