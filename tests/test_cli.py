@@ -102,3 +102,26 @@ def test_campaign_emu_finds_crash(tmp_path, capsys):
                "--crashes", str(tmp_path / "crashes"), "--stop-on-crash"])
     assert rc == 2
     assert any((tmp_path / "crashes").iterdir())
+
+
+def test_campaign_minset(tmp_path, capsys):
+    """--runs=0 = minset (reference server.h:552-556): replay seeds only,
+    outputs/ = the coverage-minimal subset, no mutations, no seed copies."""
+    inputs = tmp_path / "inputs"
+    inputs.mkdir()
+    # two identical-coverage seeds (type-1 only), one bigger seed covering
+    # types 1+2, and one small seed reaching the type-3 path nothing else
+    # covers: minset = {big, type-3 representative}
+    (inputs / "a").write_bytes(b"\x01\x02XY")
+    (inputs / "b").write_bytes(b"\x01\x02ZW")
+    (inputs / "c").write_bytes(b"\x01\x02AA\x02\x08BBBBBBBB")
+    (inputs / "d").write_bytes(b"\x03\x02ok")
+    rc = main(["campaign", "--name", "demo_tlv", "--backend", "tpu",
+               "--lanes", "4", "--target", str(tmp_path), "--runs", "0",
+               "--limit", "100000"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "minset: kept" in out
+    kept = list((tmp_path / "outputs").glob("*"))
+    # the two identical-coverage seeds collapse to one representative
+    assert len(kept) == 2, [p.name for p in kept]

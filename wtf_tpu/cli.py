@@ -110,7 +110,10 @@ def build_parser() -> argparse.ArgumentParser:
     _add_paths(camp)
     camp.add_argument("--backend", choices=BACKENDS, default="tpu")
     camp.add_argument("--limit", type=int, default=0)
-    camp.add_argument("--runs", type=int, default=0)
+    camp.add_argument("--runs", type=int, default=0,
+                      help="testcase budget; 0 = minset: replay inputs/ "
+                           "once and write the coverage-minimal subset to "
+                           "outputs/ (reference --runs=0, server.h:552-556)")
     camp.add_argument("--max_len", type=int, default=1024 * 1024)
     camp.add_argument("--seed", type=int, default=0)
     camp.add_argument("--lanes", type=int, default=64)
@@ -261,12 +264,22 @@ def cmd_campaign(args) -> int:
                              opts.limit, opts.lanes)
     target.init(backend)
     rng = random.Random(opts.seed or None)
+    # minset (--runs=0): outputs/ receives only the kept subset, so the
+    # corpus must not persist seeds there at load time
+    persist_outputs = None if opts.runs == 0 else opts.paths.outputs
     corpus = (Corpus.load_dir(opts.paths.inputs, rng=rng,
-                              outputs_dir=opts.paths.outputs)
+                              outputs_dir=persist_outputs)
               if opts.paths.inputs and Path(opts.paths.inputs).is_dir()
-              else Corpus(outputs_dir=opts.paths.outputs, rng=rng))
+              else Corpus(outputs_dir=persist_outputs, rng=rng))
     loop = FuzzLoop(backend, target, _mutator_for(target, rng, opts.max_len),
                     corpus, crashes_dir=opts.paths.crashes)
+    if opts.runs == 0:
+        # reference semantics (server.h:552-556): replay seeds only,
+        # write the coverage-minimal subset to outputs/
+        kept = loop.minset(opts.paths.outputs, print_stats=True)
+        print(loop.stats.line(len(corpus), loop._coverage()))
+        print(f"minset: kept {kept}/{len(corpus)} seeds")
+        return 0 if loop.stats.crashes == 0 else 2
     stats = loop.fuzz(runs=opts.runs, print_stats=True,
                       stop_on_crash=opts.stop_on_crash)
     print(stats.line(len(corpus), loop._coverage()))

@@ -56,13 +56,19 @@ class Client:
         sock = wire.dial(self.address, retry_for=10.0)
         try:
             while max_runs == 0 or self.runs < max_runs:
-                testcase = wire.recv_msg(sock)
+                try:
+                    testcase = wire.recv_msg(sock)
+                except OSError:
+                    break  # reset mid-recv: same as master gone
                 if testcase is None:
                     break  # master gone: node exits (client.cc:228-231)
                 result, coverage = run_testcase_and_restore(
                     self.backend, self.target, testcase)
-                wire.send_msg(
-                    sock, wire.encode_result(testcase, coverage, result))
+                try:
+                    wire.send_msg(
+                        sock, wire.encode_result(testcase, coverage, result))
+                except OSError:
+                    break  # master hung up mid-report (shutdown race)
                 self.runs += 1
         finally:
             sock.close()
@@ -89,14 +95,20 @@ class BatchClient:
                 batch: List[Optional[bytes]] = []
                 live: List[socket.socket] = []
                 for sock in socks:
-                    tc = wire.recv_msg(sock)
-                    if tc is not None:
-                        batch.append(tc)
-                        live.append(sock)
+                    try:
+                        tc = wire.recv_msg(sock)
+                    except OSError:
+                        tc = None  # reset mid-recv: lane's master is gone
+                    if tc is None:
+                        sock.close()  # lane retired: don't leak the fd
+                        continue
+                    batch.append(tc)
+                    live.append(sock)
                 if not batch:
                     break
                 socks = live
                 results = self.backend.run_batch(batch, self.target)
+                kept: List[socket.socket] = []
                 for lane, (sock, data, result) in enumerate(
                         zip(socks, batch, results)):
                     coverage = self.backend.lane_coverage(lane)
@@ -104,9 +116,15 @@ class BatchClient:
                         coverage = set()  # revoked (client.cc:122-125)
                     elif not self.backend.lane_found_new_coverage(lane):
                         coverage = set()  # nothing new to report
-                    wire.send_msg(
-                        sock, wire.encode_result(data, coverage, result))
+                    try:
+                        wire.send_msg(
+                            sock, wire.encode_result(data, coverage, result))
+                    except OSError:
+                        sock.close()  # master hung up mid-report
+                        continue
+                    kept.append(sock)
                     self.runs += 1
+                socks = kept
                 self.target.restore()
                 self.backend.restore()
                 self.rounds += 1

@@ -111,9 +111,43 @@ class FuzzLoop:
         if self.crashes_dir:
             (self.crashes_dir / name).write_bytes(data)
 
+    def minset(self, outputs_dir, print_stats: bool = False) -> int:
+        """`--runs=0` mode: replay the seed corpus exactly once — no
+        mutation — and write the coverage-increasing subset to outputs/
+        (the reference master's minset, server.h:552-556; seeds are
+        visited biggest-first per Corpus.load_dir, so the subset is
+        coverage-minimal under that ordering).  Returns the kept count."""
+        # Corpus handles digest-named persistence + dedup; outputs_dir=None
+        # (no outputs configured) counts without writing
+        kept = Corpus(outputs_dir=outputs_dir)
+        seeds = list(self.corpus)
+        for start in range(0, len(seeds), self.batch_size):
+            batch = seeds[start:start + self.batch_size]
+            results = self.backend.run_batch(batch, self.target)
+            for lane, (data, result) in enumerate(zip(batch, results)):
+                self.stats.testcases += 1
+                if isinstance(result, Timedout):
+                    self.stats.timeouts += 1
+                elif isinstance(result, Cr3Change):
+                    self.stats.cr3s += 1
+                elif isinstance(result, Crash):
+                    self.stats.crashes += 1
+                    self._save_crash(data, result)
+                if self.backend.lane_found_new_coverage(lane):
+                    self.stats.new_coverage += 1
+                    kept.add(data)
+            self.target.restore()
+            self.backend.restore()
+            now = time.time()
+            if print_stats and now - self.stats.last_print >= self.stats_every:
+                self.stats.last_print = now
+                print(self.stats.line(len(self.corpus), self._coverage()))
+        return len(kept)
+
     def fuzz(self, runs: int, print_stats: bool = False,
              stop_on_crash: bool = False) -> CampaignStats:
-        """Run until `runs` testcases executed (0 = forever)."""
+        """Run until `runs` testcases executed (0 = forever; the CLI maps
+        --runs=0 to `minset` instead, matching the reference)."""
         while runs == 0 or self.stats.testcases < runs:
             found = self.run_one_batch()
             now = time.time()
