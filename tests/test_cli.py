@@ -125,3 +125,16 @@ def test_campaign_minset(tmp_path, capsys):
     kept = list((tmp_path / "outputs").glob("*"))
     # the two identical-coverage seeds collapse to one representative
     assert len(kept) == 2, [p.name for p in kept]
+
+    # re-minimizing with a stale subsumed find in outputs/ prunes it:
+    # outputs is always exactly the measured minimal subset
+    from wtf_tpu.utils.hashing import hex_digest
+
+    stale = b"\x01\x02QQ"  # type-1 only: subsumed by the big seed
+    (tmp_path / "outputs" / hex_digest(stale)).write_bytes(stale)
+    rc = main(["campaign", "--name", "demo_tlv", "--backend", "tpu",
+               "--lanes", "4", "--target", str(tmp_path), "--runs", "0",
+               "--limit", "100000"])
+    assert rc == 0
+    kept2 = sorted(p.name for p in (tmp_path / "outputs").glob("*"))
+    assert kept2 == sorted(p.name for p in kept), kept2

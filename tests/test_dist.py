@@ -201,3 +201,28 @@ def test_batch_client_looks_like_n_nodes(tmp_path):
     assert server.stats.crashes >= 1  # OVERFLOW seed crashed
     assert len(server.coverage) > 0
     assert len(corpus) >= 1
+
+
+def test_master_resume_replays_outputs(tmp_path):
+    """A restarted master replays its persisted corpus: outputs/ files
+    from a prior campaign seed the replay queue alongside inputs/,
+    deduped by content (SURVEY §5.4 campaign-level resume)."""
+    import random
+
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.mutator import ByteMutator
+
+    inputs = tmp_path / "inputs"
+    outputs = tmp_path / "outputs"
+    inputs.mkdir()
+    outputs.mkdir()
+    (inputs / "seed1").write_bytes(b"AAAA")
+    (outputs / "prior1").write_bytes(b"BBBBBBBB")     # prior find
+    (outputs / "dup-of-seed1").write_bytes(b"AAAA")   # content-dup
+    rng = random.Random(0)
+    corpus = Corpus(outputs_dir=outputs, rng=rng)
+    server = Server("tcp://127.0.0.1:0/", ByteMutator(rng, 64), corpus,
+                    inputs_dir=inputs, runs=10)
+    # entries are lazily-read Paths, biggest first, content-deduped
+    assert [server._next_seed(), server._next_seed(), server._next_seed()] \
+        == [b"BBBBBBBB", b"AAAA", None]

@@ -274,11 +274,27 @@ def cmd_campaign(args) -> int:
     loop = FuzzLoop(backend, target, _mutator_for(target, rng, opts.max_len),
                     corpus, crashes_dir=opts.paths.crashes)
     if opts.runs == 0:
-        # reference semantics (server.h:552-556): replay seeds only,
-        # write the coverage-minimal subset to outputs/
+        # reference semantics (server.h:552-556): replay the seeds — plus
+        # any prior campaign's outputs/, so a corpus can minimize itself —
+        # and leave outputs/ holding exactly the coverage-minimal subset
+        from wtf_tpu.fuzz.corpus import seed_paths
+        from wtf_tpu.utils.hashing import hex_digest
+
+        replayed_digests = set()
+        if opts.paths.outputs and Path(opts.paths.outputs).is_dir():
+            for p in seed_paths([opts.paths.outputs]):
+                data = p.read_bytes()
+                replayed_digests.add(hex_digest(data))
+                corpus.add(data)
         kept = loop.minset(opts.paths.outputs, print_stats=True)
+        # prune replayed-and-subsumed files; files we never measured
+        # (not digest-matched) are left alone
+        if opts.paths.outputs and Path(opts.paths.outputs).is_dir():
+            for p in Path(opts.paths.outputs).iterdir():
+                if p.name in replayed_digests - kept.digests:
+                    p.unlink()
         print(loop.stats.line(len(corpus), loop._coverage()))
-        print(f"minset: kept {kept}/{len(corpus)} seeds")
+        print(f"minset: kept {len(kept)}/{len(corpus)} seeds")
         return 0 if loop.stats.crashes == 0 else 2
     stats = loop.fuzz(runs=opts.runs, print_stats=True,
                       stop_on_crash=opts.stop_on_crash)

@@ -58,8 +58,8 @@ class Client:
             while max_runs == 0 or self.runs < max_runs:
                 try:
                     testcase = wire.recv_msg(sock)
-                except OSError:
-                    break  # reset mid-recv: same as master gone
+                except (OSError, ValueError):
+                    break  # reset or desynced frame: same as master gone
                 if testcase is None:
                     break  # master gone: node exits (client.cc:228-231)
                 result, coverage = run_testcase_and_restore(
@@ -92,13 +92,13 @@ class BatchClient:
             wire.dial(self.address, retry_for=10.0) for _ in range(n)]
         try:
             while max_rounds == 0 or self.rounds < max_rounds:
-                batch: List[Optional[bytes]] = []
+                batch: List[bytes] = []
                 live: List[socket.socket] = []
                 for sock in socks:
                     try:
                         tc = wire.recv_msg(sock)
-                    except OSError:
-                        tc = None  # reset mid-recv: lane's master is gone
+                    except (OSError, ValueError):
+                        tc = None  # reset/desynced: lane's master is gone
                     if tc is None:
                         sock.close()  # lane retired: don't leak the fd
                         continue

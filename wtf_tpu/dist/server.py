@@ -81,16 +81,18 @@ class Server:
         self.stats = ServerStats()
         self.stats_every = stats_every
         self.print_stats = print_stats
-        # seed paths: biggest first (server.h:399-414)
-        self.paths: List[bytes] = []
+        # seed queue: inputs/ plus any prior campaign's outputs/ — a
+        # restarted master resumes by replaying its persisted corpus
+        # (SURVEY §5.4; reference server.h:399-414).  Entries are Paths
+        # read lazily at serve time (a resumed multi-GB corpus must not
+        # materialize in memory at startup); dirwatch injections are bytes.
+        from wtf_tpu.fuzz.corpus import seed_paths
+
+        self.paths: List = list(
+            seed_paths([inputs_dir, corpus.outputs_dir]))
         self._dirwatch = None
         self._dirwatch_last = 0.0
         if inputs_dir:
-            if Path(inputs_dir).is_dir():
-                files = sorted((p for p in Path(inputs_dir).iterdir()
-                                if p.is_file()),
-                               key=lambda p: p.stat().st_size, reverse=True)
-                self.paths = [p.read_bytes() for p in files]
             # mid-campaign injection: operators drop seeds into inputs/
             # while the master runs (reference dirwatch.h); constructed
             # even when the dir doesn't exist yet — it may appear later
@@ -104,9 +106,21 @@ class Server:
         self._clients: Dict[socket.socket, bool] = {}  # sock -> sent?
 
     # -- testcase generation (server.h:629-714) ----------------------------
+    def _next_seed(self) -> Optional[bytes]:
+        while self.paths:
+            item = self.paths.pop(0)
+            if isinstance(item, Path):
+                try:
+                    return item.read_bytes()[:self.max_len]
+                except OSError:
+                    continue  # vanished since the startup scan
+            return item[:self.max_len]
+        return None
+
     def get_testcase(self) -> Optional[bytes]:
-        if self.paths:
-            return self.paths.pop(0)[:self.max_len]
+        seed = self._next_seed()
+        if seed is not None:
+            return seed
         if self.runs and self.mutations >= self.runs:
             return None
         if self.runs == 0:

@@ -50,15 +50,36 @@ class Corpus:
     def __iter__(self):
         return iter(self._items)
 
+    @property
+    def digests(self) -> set:
+        return set(self._digests)
+
     @staticmethod
     def load_dir(path: Path, rng: Optional[random.Random] = None,
                  outputs_dir: Optional[Path] = None) -> "Corpus":
         """Seed from a directory of input files, biggest first (the
         reference master replays inputs/ sorted by size, server.h:399-414)."""
         corpus = Corpus(outputs_dir=outputs_dir, rng=rng)
-        files = sorted(Path(path).glob("*"),
-                       key=lambda p: p.stat().st_size, reverse=True)
-        for f in files:
-            if f.is_file():
-                corpus.add(f.read_bytes())
+        for f in seed_paths([path]):
+            corpus.add(f.read_bytes())
         return corpus
+
+
+def seed_paths(dirs) -> List[Path]:
+    """Seed files from one or more directories, size-sorted biggest first
+    and content-deduped (the reference master's replay ordering,
+    server.h:399-414) — the ONE implementation of that policy; bytes are
+    read transiently for digesting, only paths are retained."""
+    files = sorted((p for d in dirs if d and Path(d).is_dir()
+                    for p in Path(d).iterdir() if p.is_file()),
+                   key=lambda p: p.stat().st_size, reverse=True)
+    seen, out = set(), []
+    for p in files:
+        try:
+            digest = hex_digest(p.read_bytes())
+        except OSError:
+            continue  # vanished mid-scan
+        if digest not in seen:
+            seen.add(digest)
+            out.append(p)
+    return out

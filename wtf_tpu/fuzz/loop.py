@@ -111,12 +111,13 @@ class FuzzLoop:
         if self.crashes_dir:
             (self.crashes_dir / name).write_bytes(data)
 
-    def minset(self, outputs_dir, print_stats: bool = False) -> int:
+    def minset(self, outputs_dir, print_stats: bool = False) -> Corpus:
         """`--runs=0` mode: replay the seed corpus exactly once — no
         mutation — and write the coverage-increasing subset to outputs/
         (the reference master's minset, server.h:552-556; seeds are
         visited biggest-first per Corpus.load_dir, so the subset is
-        coverage-minimal under that ordering).  Returns the kept count."""
+        coverage-minimal under that ordering).  Returns the kept Corpus
+        (callers prune subsumed stale files with its digest set)."""
         # Corpus handles digest-named persistence + dedup; outputs_dir=None
         # (no outputs configured) counts without writing
         kept = Corpus(outputs_dir=outputs_dir)
@@ -142,7 +143,7 @@ class FuzzLoop:
             if print_stats and now - self.stats.last_print >= self.stats_every:
                 self.stats.last_print = now
                 print(self.stats.line(len(self.corpus), self._coverage()))
-        return len(kept)
+        return kept
 
     def fuzz(self, runs: int, print_stats: bool = False,
              stop_on_crash: bool = False) -> CampaignStats:
