@@ -522,3 +522,18 @@ def test_iretq_returns_through_frame():
     assert cpu.rflags & 0x400            # DF from the popped frame
     # rsp restored from the frame (r9 captured it before the pushes)
     assert cpu.gpr[4] == cpu.gpr[9]
+
+
+def test_decoder_total_on_random_bytes():
+    """The decoder is total: any byte window decodes to SOME uop (invalid
+    encodings map to OPC_INVALID, never an exception) with a sane length —
+    a fuzzer's decoder sees every byte sequence the mutator can produce."""
+    import random as _random
+
+    from wtf_tpu.cpu.decoder import decode
+
+    rng = _random.Random(0xDEC0DE)
+    for _ in range(3000):
+        window = bytes(rng.randrange(256) for _ in range(15))
+        uop = decode(window, 0x1000)
+        assert 1 <= uop.length <= 15

@@ -276,25 +276,35 @@ def cmd_campaign(args) -> int:
     if opts.runs == 0:
         # reference semantics (server.h:552-556): replay the seeds — plus
         # any prior campaign's outputs/, so a corpus can minimize itself —
-        # and leave outputs/ holding exactly the coverage-minimal subset
-        from wtf_tpu.fuzz.corpus import seed_paths
+        # and leave outputs/ holding exactly the coverage-minimal subset.
+        # One globally size-ordered, content-deduped scan (the ordering
+        # minset's minimality depends on), digesting each file once.
+        from wtf_tpu.fuzz.corpus import Corpus as _Corpus, seed_paths
+
+        seed_corpus = _Corpus(rng=rng)
+        for p, _ in seed_paths([opts.paths.inputs, opts.paths.outputs]):
+            seed_corpus.add(p.read_bytes())
+        loop.corpus = seed_corpus
+        kept = loop.minset(opts.paths.outputs, print_stats=True)
+        # outputs/ ends as exactly the kept set: every outputs file's
+        # content was measured (directly or via a content-identical
+        # twin), so prune by content digest — a raw directory walk, not
+        # seed_paths, so content-duplicate files are all caught
         from wtf_tpu.utils.hashing import hex_digest
 
-        replayed_digests = set()
-        if opts.paths.outputs and Path(opts.paths.outputs).is_dir():
-            for p in seed_paths([opts.paths.outputs]):
-                data = p.read_bytes()
-                replayed_digests.add(hex_digest(data))
-                corpus.add(data)
-        kept = loop.minset(opts.paths.outputs, print_stats=True)
-        # prune replayed-and-subsumed files; files we never measured
-        # (not digest-matched) are left alone
-        if opts.paths.outputs and Path(opts.paths.outputs).is_dir():
-            for p in Path(opts.paths.outputs).iterdir():
-                if p.name in replayed_digests - kept.digests:
-                    p.unlink()
-        print(loop.stats.line(len(corpus), loop._coverage()))
-        print(f"minset: kept {len(kept)}/{len(corpus)} seeds")
+        out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
+        if out_dir and out_dir.is_dir():
+            for p in out_dir.iterdir():
+                if not p.is_file():
+                    continue
+                try:
+                    digest = hex_digest(p.read_bytes())
+                except OSError:
+                    continue
+                if not (digest in kept.digests and p.name == digest):
+                    p.unlink(missing_ok=True)
+        print(loop.stats.line(len(seed_corpus), loop._coverage()))
+        print(f"minset: kept {len(kept)}/{len(seed_corpus)} seeds")
         return 0 if loop.stats.crashes == 0 else 2
     stats = loop.fuzz(runs=opts.runs, print_stats=True,
                       stop_on_crash=opts.stop_on_crash)

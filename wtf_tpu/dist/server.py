@@ -88,8 +88,8 @@ class Server:
         # materialize in memory at startup); dirwatch injections are bytes.
         from wtf_tpu.fuzz.corpus import seed_paths
 
-        self.paths: List = list(
-            seed_paths([inputs_dir, corpus.outputs_dir]))
+        self.paths: List = [
+            p for p, _ in seed_paths([inputs_dir, corpus.outputs_dir])]
         self._dirwatch = None
         self._dirwatch_last = 0.0
         if inputs_dir:
@@ -103,7 +103,8 @@ class Server:
         self.mutations = 0
         self.crash_names: Set[str] = set()
         self._listener: Optional[socket.socket] = None
-        self._clients: Dict[socket.socket, bool] = {}  # sock -> sent?
+        # sock -> in-flight testcase bytes (None = idle, awaiting a feed)
+        self._clients: Dict[socket.socket, Optional[bytes]] = {}
 
     # -- testcase generation (server.h:629-714) ----------------------------
     def _next_seed(self) -> Optional[bytes]:
@@ -129,7 +130,7 @@ class Server:
         return self.mutator.get_new_testcase(self.corpus)[:self.max_len]
 
     def done(self) -> bool:
-        outstanding = any(self._clients.values())
+        outstanding = any(v is not None for v in self._clients.values())
         if outstanding or self.paths:
             return False
         if self.runs == 0:
@@ -169,14 +170,15 @@ class Server:
                     break
                 rlist = [self._listener] + list(self._clients)
                 # lock-step: only clients we haven't fed yet are writable
-                wlist = [c for c, sent in self._clients.items() if not sent]
+                wlist = [c for c, inflight in self._clients.items()
+                         if inflight is None]
                 ready_r, ready_w, _ = select.select(rlist, wlist, [], 0.5)
                 for sock in ready_w:
                     self._feed(sock)
                 for sock in ready_r:
                     if sock is self._listener:
                         conn, _ = self._listener.accept()
-                        self._clients[conn] = False
+                        self._clients[conn] = None
                         continue
                     self._on_readable(sock)
                 now = time.time()
@@ -229,9 +231,14 @@ class Server:
             return  # budget exhausted; leave client idle until done()
         try:
             wire.send_msg(sock, testcase)
-            self._clients[sock] = True
+            self._clients[sock] = testcase  # in-flight until its result
         except OSError:
+            # undelivered: requeue (budget stays consumed — the requeued
+            # entry re-serves from paths without a new mutation, so the
+            # campaign still executes exactly `runs` testcases despite
+            # client churn; elasticity, server.h:534-544)
             self._drop(sock)
+            self.paths[:0] = [testcase]
 
     def _on_readable(self, sock: socket.socket) -> None:
         try:
@@ -242,10 +249,13 @@ class Server:
             self._drop(sock)
             return
         self.handle_result(body)
-        self._clients[sock] = False
+        self._clients[sock] = None
 
     def _drop(self, sock: socket.socket) -> None:
-        self._clients.pop(sock, None)
+        # a dying client's in-flight testcase is re-served to someone else
+        inflight = self._clients.pop(sock, None)
+        if inflight is not None:
+            self.paths[:0] = [inflight]
         sock.close()
 
     def _maybe_print(self) -> None:

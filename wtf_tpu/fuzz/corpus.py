@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import random
 from pathlib import Path
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from wtf_tpu.utils.hashing import hex_digest
 
@@ -60,26 +60,34 @@ class Corpus:
         """Seed from a directory of input files, biggest first (the
         reference master replays inputs/ sorted by size, server.h:399-414)."""
         corpus = Corpus(outputs_dir=outputs_dir, rng=rng)
-        for f in seed_paths([path]):
+        for f, _ in seed_paths([path]):
             corpus.add(f.read_bytes())
         return corpus
 
 
-def seed_paths(dirs) -> List[Path]:
-    """Seed files from one or more directories, size-sorted biggest first
-    and content-deduped (the reference master's replay ordering,
-    server.h:399-414) — the ONE implementation of that policy; bytes are
-    read transiently for digesting, only paths are retained."""
-    files = sorted((p for d in dirs if d and Path(d).is_dir()
-                    for p in Path(d).iterdir() if p.is_file()),
-                   key=lambda p: p.stat().st_size, reverse=True)
+def seed_paths(dirs) -> List[Tuple[Path, str]]:
+    """Seed files from one or more directories as (path, content digest)
+    pairs, size-sorted biggest first and content-deduped (the reference
+    master's replay ordering, server.h:399-414) — the ONE implementation
+    of that policy.  Bytes are read transiently for digesting; files
+    vanishing mid-scan are skipped."""
+    sized = []
+    for d in dirs:
+        if not (d and Path(d).is_dir()):
+            continue
+        for p in Path(d).iterdir():
+            try:
+                if p.is_file():
+                    sized.append((p.stat().st_size, p))
+            except OSError:
+                continue  # vanished mid-scan
     seen, out = set(), []
-    for p in files:
+    for _, p in sorted(sized, key=lambda t: t[0], reverse=True):
         try:
             digest = hex_digest(p.read_bytes())
         except OSError:
             continue  # vanished mid-scan
         if digest not in seen:
             seen.add(digest)
-            out.append(p)
+            out.append((p, digest))
     return out
