@@ -106,3 +106,21 @@ def _stop_handler(runner, view, lane):
     from wtf_tpu.core.results import StatusCode
 
     view.set_status(lane, StatusCode.OK)
+
+
+def test_merged_coverage_groups_hint(mesh):
+    """Passing groups = mesh.size (the wide-mesh escape hatch) produces
+    the same union as the default grouping."""
+    r = _runner()
+    run_chunk = make_run_chunk(8)
+    machine = shard_machine(r.machine, mesh)
+    tab = replicate(r.cache.device(), mesh)
+    image = replicate(r.physmem.image, mesh)
+    with mesh:
+        machine = run_chunk(tab, image, machine, jnp.uint64(500))
+        cov_default, edge_default = merged_coverage(machine)
+        cov_hint, edge_hint = merged_coverage(machine, groups=mesh.size)
+    np.testing.assert_array_equal(np.asarray(cov_hint),
+                                  np.asarray(cov_default))
+    np.testing.assert_array_equal(np.asarray(edge_hint),
+                                  np.asarray(edge_default))

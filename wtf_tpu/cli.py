@@ -264,13 +264,16 @@ def cmd_campaign(args) -> int:
                              opts.limit, opts.lanes)
     target.init(backend)
     rng = random.Random(opts.seed or None)
-    # minset (--runs=0): outputs/ receives only the kept subset, so the
-    # corpus must not persist seeds there at load time
-    persist_outputs = None if opts.runs == 0 else opts.paths.outputs
-    corpus = (Corpus.load_dir(opts.paths.inputs, rng=rng,
-                              outputs_dir=persist_outputs)
-              if opts.paths.inputs and Path(opts.paths.inputs).is_dir()
-              else Corpus(outputs_dir=persist_outputs, rng=rng))
+    # minset (--runs=0) fills its corpus from ONE merged scan below (no
+    # double read of inputs/); fuzz mode loads inputs and persists
+    # coverage-increasing finds into outputs/
+    if opts.runs == 0:
+        corpus = Corpus(rng=rng)
+    elif opts.paths.inputs and Path(opts.paths.inputs).is_dir():
+        corpus = Corpus.load_dir(opts.paths.inputs, rng=rng,
+                                 outputs_dir=opts.paths.outputs)
+    else:
+        corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
     loop = FuzzLoop(backend, target, _mutator_for(target, rng, opts.max_len),
                     corpus, crashes_dir=opts.paths.crashes)
     if opts.runs == 0:
@@ -278,33 +281,37 @@ def cmd_campaign(args) -> int:
         # any prior campaign's outputs/, so a corpus can minimize itself —
         # and leave outputs/ holding exactly the coverage-minimal subset.
         # One globally size-ordered, content-deduped scan (the ordering
-        # minset's minimality depends on), digesting each file once.
-        from wtf_tpu.fuzz.corpus import Corpus as _Corpus, seed_paths
-
-        seed_corpus = _Corpus(rng=rng)
-        for p, _ in seed_paths([opts.paths.inputs, opts.paths.outputs]):
-            seed_corpus.add(p.read_bytes())
-        loop.corpus = seed_corpus
-        kept = loop.minset(opts.paths.outputs, print_stats=True)
-        # outputs/ ends as exactly the kept set: every outputs file's
-        # content was measured (directly or via a content-identical
-        # twin), so prune by content digest — a raw directory walk, not
-        # seed_paths, so content-duplicate files are all caught
+        # minset's minimality depends on).
+        from wtf_tpu.fuzz.corpus import seed_paths
         from wtf_tpu.utils.hashing import hex_digest
 
+        # snapshot every pre-existing outputs file (pre-dedup): these are
+        # the ONLY prune candidates — files appearing after this walk
+        # were never measured and are left alone
+        outputs_snapshot = []
         out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
         if out_dir and out_dir.is_dir():
             for p in out_dir.iterdir():
-                if not p.is_file():
-                    continue
-                try:
-                    digest = hex_digest(p.read_bytes())
-                except OSError:
-                    continue
-                if not (digest in kept.digests and p.name == digest):
-                    p.unlink(missing_ok=True)
-        print(loop.stats.line(len(seed_corpus), loop._coverage()))
-        print(f"minset: kept {len(kept)}/{len(seed_corpus)} seeds")
+                if p.is_file():
+                    try:
+                        outputs_snapshot.append(
+                            (p, hex_digest(p.read_bytes())))
+                    except OSError:
+                        continue
+        for p, _ in seed_paths([opts.paths.inputs, opts.paths.outputs]):
+            try:
+                corpus.add(p.read_bytes())
+            except OSError:
+                continue
+        kept = loop.minset(opts.paths.outputs, print_stats=True)
+        # outputs/ ends as exactly the kept subset of what was measured:
+        # every snapshot file's content was replayed (directly or via a
+        # content-identical twin), so prune by content digest
+        for p, digest in outputs_snapshot:
+            if not (digest in kept.digests and p.name == digest):
+                p.unlink(missing_ok=True)
+        print(loop.stats.line(len(corpus), loop._coverage()))
+        print(f"minset: kept {len(kept)}/{len(corpus)} seeds")
         return 0 if loop.stats.crashes == 0 else 2
     stats = loop.fuzz(runs=opts.runs, print_stats=True,
                       stop_on_crash=opts.stop_on_crash)

@@ -102,6 +102,7 @@ class Server:
         self.coverage: Set[int] = set()
         self.mutations = 0
         self.crash_names: Set[str] = set()
+        self._ever_served = False
         self._listener: Optional[socket.socket] = None
         # sock -> in-flight testcase bytes (None = idle, awaiting a feed)
         self._clients: Dict[socket.socket, Optional[bytes]] = {}
@@ -131,11 +132,18 @@ class Server:
 
     def done(self) -> bool:
         outstanding = any(v is not None for v in self._clients.values())
-        if outstanding or self.paths:
+        if outstanding:
             return False
-        if self.runs == 0:
-            return True
-        return self.mutations >= self.runs
+        gen_done = self.mutations >= self.runs if self.runs else True
+        if not gen_done:
+            return False
+        if self.paths:
+            # remaining/requeued seeds count only while someone can serve
+            # them; once the campaign is under way and every client is
+            # gone, they are lost — as in the reference — and the master
+            # terminates instead of waiting forever for a reconnect
+            return self._ever_served and not self._clients
+        return True
 
     # -- result handling (server.h:785-886) --------------------------------
     def handle_result(self, body: bytes) -> None:
@@ -228,10 +236,16 @@ class Server:
     def _feed(self, sock: socket.socket) -> None:
         testcase = self.get_testcase()
         if testcase is None:
-            return  # budget exhausted; leave client idle until done()
+            # no work left (budget exhausted / seeds drained): close the
+            # idle client now — a batch node collecting one testcase per
+            # lane would otherwise block on this socket while the master
+            # waits for the node's other lanes' results (tail deadlock)
+            self._drop(sock)
+            return
         try:
             wire.send_msg(sock, testcase)
             self._clients[sock] = testcase  # in-flight until its result
+            self._ever_served = True
         except OSError:
             # undelivered: requeue (budget stays consumed — the requeued
             # entry re-serves from paths without a new mutation, so the
