@@ -118,6 +118,24 @@ HW_CASES = [
     ("flags_chain", "add rax, rbx\nadc rcx, rdx\nsbb rsi, rdi", FLAGS_MASK),
     # flags depend on the (differing) rsp value — compare registers only
     ("stack_red", "sub rsp, 32\nmov [rsp], rax\nmov rbx, [rsp]\nadd rsp, 32", 0),
+    # BMI1/BMI2 (VEX-encoded; masks follow the SDM's defined-flags sets)
+    ("andn", "andn rax, rbx, rcx", 0x8C1),
+    ("andn32", "andn eax, ebx, ecx", 0x8C1),
+    ("bzhi", "bzhi rax, rbx, rcx", 0x8C1),
+    ("bzhi_over", "mov rcx, 200\nbzhi rax, rbx, rcx", 0x8C1),
+    ("bextr", "bextr rax, rbx, rcx", 0x841),
+    ("shlx", "shlx rax, rbx, rcx", 0),
+    ("shrx", "shrx rax, rbx, rcx", 0),
+    ("sarx", "sarx rax, rbx, rcx", 0),
+    ("pdep", "pdep rax, rbx, rcx", 0),
+    ("pext", "pext rax, rbx, rcx", 0),
+    ("rorx", "rorx rax, rbx, 13", 0),
+    ("rorx32", "rorx eax, ebx, 5", 0),
+    ("blsr", "blsr rax, rbx", 0x8C1),
+    ("blsr_zero", "xor rbx, rbx\nblsr rax, rbx", 0x8C1),
+    ("blsmsk", "blsmsk rax, rbx", 0x881),
+    ("blsi", "blsi rax, rbx", 0x8C1),
+    ("blsi_zero", "xor rbx, rbx\nblsi rax, rbx", 0x8C1),
 ]
 
 _INIT_REGS = [
@@ -537,3 +555,15 @@ def test_decoder_total_on_random_bytes():
         window = bytes(rng.randrange(256) for _ in range(15))
         uop = decode(window, 0x1000)
         assert 1 <= uop.length <= 15
+
+
+def test_vex_after_prefix_is_invalid():
+    """A legacy or REX prefix before VEX #UDs on hardware; the decoder
+    must reject the sequence rather than decode the VEX form."""
+    from wtf_tpu.cpu.uops import OPC_INVALID, OPC_PEXT
+
+    shlx = assemble("shlx rax, rbx, rcx")
+    assert decode(shlx + b"\x90" * 8).opc == OPC_PEXT
+    for prefix in (b"\x66", b"\xF2", b"\xF3", b"\x40", b"\x48"):
+        uop = decode(prefix + shlx + b"\x90" * 8)
+        assert uop.opc == OPC_INVALID, prefix.hex()

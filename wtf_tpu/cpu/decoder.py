@@ -104,6 +104,8 @@ class _Prefixes:
         self.rep = False     # F3
         self.seg = SEG_NONE
         self.rex = 0         # 0 = no REX
+        self.any_legacy = False   # any legacy prefix seen (VEX validity)
+        self.rex_present = False  # a REX byte seen, even 0x40
 
     @property
     def rex_w(self) -> bool:
@@ -268,10 +270,12 @@ def _decode_prefixes(cur: _Cursor) -> _Prefixes:
             pass  # es/cs/ss/ds overrides are no-ops in long mode
         else:
             break
+        pfx.any_legacy = True
         cur.pos += 1
     b = cur.peek()
     if 0x40 <= b <= 0x4F:
         pfx.rex = b & 0xF
+        pfx.rex_present = True
         cur.pos += 1
     return pfx
 
@@ -285,13 +289,104 @@ def _decode_inner(code: bytes) -> Uop:
     uop = Uop()
     uop.lock = int(pfx.lock)
 
-    if op == 0x0F:
+    if op in (0xC4, 0xC5) and not pfx.any_legacy and not pfx.rex_present:
+        # VEX prefix (in long mode C4/C5 are always VEX; LES/LDS invalid).
+        # Any legacy or REX prefix before VEX #UDs on hardware, so such
+        # sequences fall through and decode invalid.
+        _decode_vex(op, cur, pfx, uop)
+    elif op == 0x0F:
         _decode_0f(cur, pfx, uop)
     else:
         _decode_primary(op, cur, pfx, uop)
 
     uop.length = cur.pos
     return uop
+
+
+# ---------------------------------------------------------------------------
+# VEX map — the BMI1/BMI2 scalar subset (AVX forms stay OPC_INVALID).
+# Three-operand encoding convention: dst_reg = destination, the r/m goes
+# through the normal src machinery (register or memory), and the VEX.vvvv
+# register rides in `uop.cond` (unused by this opcode class otherwise).
+# ---------------------------------------------------------------------------
+
+def _decode_vex(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
+    if op == 0xC5:  # 2-byte form: R.vvvv.L.pp, map = 0F
+        b1 = cur.u8()
+        r = (~b1 >> 7) & 1
+        x = b = w = 0
+        vvvv = (~b1 >> 3) & 0xF
+        l_bit = (b1 >> 2) & 1
+        pp = b1 & 3
+        mmmmm = 1
+    else:           # 3-byte form: RXB.mmmmm, W.vvvv.L.pp
+        b1 = cur.u8()
+        b2 = cur.u8()
+        r = (~b1 >> 7) & 1
+        x = (~b1 >> 6) & 1
+        b = (~b1 >> 5) & 1
+        mmmmm = b1 & 0x1F
+        w = (b2 >> 7) & 1
+        vvvv = (~b2 >> 3) & 0xF
+        l_bit = (b2 >> 2) & 1
+        pp = b2 & 3
+    opc = cur.u8()
+    # reuse the legacy ModRM machinery: VEX.RXB/W are REX-equivalent
+    pfx.rex = (w << 3) | (r << 2) | (x << 1) | b
+    opsize = 8 if w else 4
+
+    if l_bit:  # VEX.256 (AVX) — not in the scalar subset
+        uop.opc = OPC_INVALID
+        return
+
+    if mmmmm == 2:  # 0F38 map
+        if opc == 0xF2 and pp == 0:  # andn r, vvvv, r/m
+            uop.opc, uop.sub, uop.opsize = OPC_PEXT, BMI_ANDN, opsize
+            modrm = _ModRM(cur, pfx)
+            _reg_operand(uop, modrm, pfx, is_dst=True)
+            _rm_operand(uop, modrm, pfx, is_dst=False)
+            uop.cond = vvvv
+            return
+        if opc == 0xF3 and pp == 0:  # blsr/blsmsk/blsi vvvv, r/m
+            modrm = _ModRM(cur, pfx)
+            group = {1: BMI_BLSR, 2: BMI_BLSMSK, 3: BMI_BLSI}
+            digit = modrm.reg & 7  # opcode extension, not a register
+            if digit not in group:
+                uop.opc = OPC_INVALID
+                return
+            uop.opc, uop.sub, uop.opsize = OPC_PEXT, group[digit], opsize
+            uop.dst_kind, uop.dst_reg = K_REG, vvvv
+            _rm_operand(uop, modrm, pfx, is_dst=False)
+            return
+        if opc == 0xF5:  # bzhi (pp=0) / pext (F3) / pdep (F2): r, r/m, vvvv
+            sub = {0: BMI_BZHI, 2: BMI_PEXT_, 3: BMI_PDEP}.get(pp)
+            if sub is None:
+                uop.opc = OPC_INVALID
+                return
+            uop.opc, uop.sub, uop.opsize = OPC_PEXT, sub, opsize
+            modrm = _ModRM(cur, pfx)
+            _reg_operand(uop, modrm, pfx, is_dst=True)
+            _rm_operand(uop, modrm, pfx, is_dst=False)
+            uop.cond = vvvv
+            return
+        if opc == 0xF7:  # bextr (pp=0) / shlx (66) / sarx (F3) / shrx (F2)
+            sub = {0: BMI_BEXTR, 1: BMI_SHLX, 2: BMI_SARX, 3: BMI_SHRX}[pp]
+            uop.opc, uop.sub, uop.opsize = OPC_PEXT, sub, opsize
+            modrm = _ModRM(cur, pfx)
+            _reg_operand(uop, modrm, pfx, is_dst=True)
+            _rm_operand(uop, modrm, pfx, is_dst=False)
+            uop.cond = vvvv
+            return
+        uop.opc = OPC_INVALID
+        return
+    if mmmmm == 3 and opc == 0xF0 and pp == 3:  # rorx r, r/m, imm8
+        uop.opc, uop.sub, uop.opsize = OPC_PEXT, BMI_RORX, opsize
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        uop.imm = cur.u8()
+        return
+    uop.opc = OPC_INVALID
 
 
 # ---------------------------------------------------------------------------

@@ -568,6 +568,65 @@ class EmuCpu:
             tsc = (self.tsc + self.icount) & MASK64
             self.write_reg(0, 8, tsc & 0xFFFFFFFF)
             self.write_reg(2, 8, tsc >> 32)
+        elif opc == U.OPC_PEXT:
+            # BMI1/BMI2 scalar bit ops (VEX-encoded).  Third operand
+            # (VEX.vvvv) rides in uop.cond per the decoder's convention.
+            src = load_src()                      # the r/m operand
+            third = self.read_reg(uop.cond, opsize)
+            sub = uop.sub
+            if sub == U.BMI_ANDN:                 # dst = ~vvvv & r/m
+                res = (~third & src) & mask
+                self.set_flags(sf=bool(res >> (bits - 1)), zf=res == 0,
+                               cf=False, of=False)
+            elif sub == U.BMI_BZHI:               # zero bits >= vvvv[7:0]
+                n = third & 0xFF
+                res = src & ((1 << n) - 1) if n < bits else src
+                self.set_flags(cf=n > bits - 1, zf=res == 0,
+                               sf=bool(res >> (bits - 1)), of=False)
+            elif sub == U.BMI_BEXTR:              # field extract by vvvv
+                start = third & 0xFF
+                ln = (third >> 8) & 0xFF
+                res = (src >> start) & ((1 << ln) - 1) if start < bits else 0
+                res &= mask
+                self.set_flags(zf=res == 0, cf=False, of=False)
+            elif sub in (U.BMI_SHLX, U.BMI_SHRX, U.BMI_SARX):  # no flags
+                cnt = third & (63 if opsize == 8 else 31)
+                if sub == U.BMI_SHLX:
+                    res = (src << cnt) & mask
+                elif sub == U.BMI_SHRX:
+                    res = src >> cnt
+                else:
+                    res = (_sx(src, bits) >> cnt) & mask
+            elif sub == U.BMI_PDEP:               # deposit vvvv into r/m mask
+                res, k = 0, 0
+                for i in range(bits):
+                    if (src >> i) & 1:
+                        res |= ((third >> k) & 1) << i
+                        k += 1
+            elif sub == U.BMI_PEXT_:              # extract r/m-mask bits of vvvv
+                res, k = 0, 0
+                for i in range(bits):
+                    if (src >> i) & 1:
+                        res |= ((third >> i) & 1) << k
+                        k += 1
+            elif sub == U.BMI_BLSR:               # clear lowest set bit
+                res = src & (src - 1) & mask
+                self.set_flags(cf=src == 0, zf=res == 0,
+                               sf=bool(res >> (bits - 1)), of=False)
+            elif sub == U.BMI_BLSMSK:             # mask up to lowest set bit
+                res = (src ^ (src - 1)) & mask
+                self.set_flags(cf=src == 0, zf=res == 0,
+                               sf=bool(res >> (bits - 1)), of=False)
+            elif sub == U.BMI_BLSI:               # isolate lowest set bit
+                res = src & (-src & mask) & mask
+                self.set_flags(cf=src != 0, zf=res == 0,
+                               sf=bool(res >> (bits - 1)), of=False)
+            elif sub == U.BMI_RORX:               # rotate right, no flags
+                n = uop.imm & (63 if opsize == 8 else 31)
+                res = ((src >> n) | (src << (bits - n))) & mask if n else src
+            else:
+                raise UnsupportedInsn(self.rip, uop.raw)
+            self.write_reg(uop.dst_reg, opsize, res)
         elif opc == U.OPC_MSR:
             # rdmsr/wrmsr over the MSR-backed fields the snapshot carries
             # (reference: bochs/KVM MSR state, kvm_backend.cc LoadMsrs)

@@ -138,10 +138,13 @@ class Server:
         if not gen_done:
             return False
         if self.paths:
-            # remaining/requeued seeds count only while someone can serve
-            # them; once the campaign is under way and every client is
-            # gone, they are lost — as in the reference — and the master
-            # terminates instead of waiting forever for a reconnect
+            # original seed FILES (lazy Paths) always wait for a client to
+            # (re)connect — a mid-replay disconnect must not end a minset
+            # with the bulk unserved.  Only requeued/injected BYTE entries
+            # (in-flight testcases of dead clients) are treated as lost
+            # once every client is gone, as in the reference.
+            if any(isinstance(item, Path) for item in self.paths):
+                return False
             return self._ever_served and not self._clients
         return True
 
@@ -249,8 +252,8 @@ class Server:
         except OSError:
             # undelivered: requeue (budget stays consumed — the requeued
             # entry re-serves from paths without a new mutation, so the
-            # campaign still executes exactly `runs` testcases despite
-            # client churn; elasticity, server.h:534-544)
+            # campaign executes exactly `runs` testcases as long as any
+            # client remains connected; elasticity, server.h:534-544)
             self._drop(sock)
             self.paths[:0] = [testcase]
 

@@ -28,7 +28,10 @@ class Corpus:
 
     def add(self, data: bytes) -> bool:
         """Insert + persist; returns False for duplicates (content hash)."""
-        digest = hex_digest(data)
+        return self.add_digested(data, hex_digest(data))
+
+    def add_digested(self, data: bytes, digest: str) -> bool:
+        """`add` for callers that already hold the content digest."""
         if digest in self._digests:
             return False
         self._digests.add(digest)
