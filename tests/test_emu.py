@@ -567,3 +567,10 @@ def test_vex_after_prefix_is_invalid():
     for prefix in (b"\x66", b"\xF2", b"\xF3", b"\x40", b"\x48"):
         uop = decode(prefix + shlx + b"\x90" * 8)
         assert uop.opc == OPC_INVALID, prefix.hex()
+    # segment overrides are LEGAL before VEX (they scope the mem operand)
+    gs_andn = b"\x65" + assemble("andn rax, rbx, [rcx]")
+    uop = decode(gs_andn + b"\x90" * 8)
+    assert uop.opc == OPC_PEXT and uop.seg == U.SEG_GS
+    # rorx requires encoded VEX.vvvv == 1111b; hardware #UDs otherwise
+    assert decode(bytes([0xC4, 0xE3, 0x43, 0xF0, 0xC3, 0x0D]) +
+                  b"\x90" * 8).opc == OPC_INVALID

@@ -236,6 +236,17 @@ DIFF_CASES = [
         movdqa xmm2, xmm0
         por xmm2, xmm1
         hlt""", {DATA_BASE: bytes(range(32)) + b"\x00" * 0x100}),
+    ("sse_punpckldq_paddq", f"""
+        mov rbx, {DATA_BASE}
+        movdqu xmm0, [rbx]
+        movdqu xmm1, [rbx+16]
+        punpckldq xmm0, xmm1
+        movdqu xmm2, [rbx]
+        paddq xmm2, xmm1
+        paddq xmm2, [rbx+16]
+        movdqu [rbx+32], xmm0
+        movdqu [rbx+48], xmm2
+        hlt""", {DATA_BASE: bytes(range(200, 232)) + b"\x00" * 0x100}),
     ("sse_movq_movd", f"""
         mov rax, 0x1122334455667788
         movq xmm0, rax

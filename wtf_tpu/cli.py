@@ -280,31 +280,28 @@ def cmd_campaign(args) -> int:
         # reference semantics (server.h:552-556): replay the seeds — plus
         # any prior campaign's outputs/, so a corpus can minimize itself —
         # and leave outputs/ holding exactly the coverage-minimal subset.
-        # ONE read+digest per seed file: walk inputs/ and outputs/ once,
-        # then feed the corpus globally size-ordered and content-deduped
-        # (the ordering minset's minimality depends on)
+        # seed the corpus through the shared replay-ordering policy
+        # (size-sorted, content-deduped — minset's minimality depends on
+        # it), reading+digesting each seed exactly once
+        from wtf_tpu.fuzz.corpus import seed_paths
         from wtf_tpu.utils.hashing import hex_digest
 
-        out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
-        entries = []  # (size, data, digest, path, from_outputs)
-        for d, from_out in ((opts.paths.inputs, False), (out_dir, True)):
-            if not (d and Path(d).is_dir()):
-                continue
-            for p in Path(d).iterdir():
-                if not p.is_file():
-                    continue
-                try:
-                    data = p.read_bytes()
-                except OSError:
-                    continue
-                entries.append((len(data), data, hex_digest(data), p,
-                                from_out))
-        # prune candidates: the pre-existing outputs files (pre-dedup);
-        # files appearing after this walk were never measured and stay
-        outputs_snapshot = [(p, dg) for _, _, dg, p, out in entries if out]
-        for _, data, digest, _, _ in sorted(
-                entries, key=lambda t: t[0], reverse=True):
+        for _, digest, data in seed_paths(
+                [opts.paths.inputs, opts.paths.outputs], with_data=True):
             corpus.add_digested(data, digest)
+        # prune candidates: every pre-existing outputs file (pre-dedup —
+        # content-duplicate files must all be caught); files appearing
+        # after this walk were never measured and stay untouched
+        outputs_snapshot = []
+        out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
+        if out_dir and out_dir.is_dir():
+            for p in out_dir.iterdir():
+                if p.is_file():
+                    try:
+                        outputs_snapshot.append(
+                            (p, hex_digest(p.read_bytes())))
+                    except OSError:
+                        continue
         kept = loop.minset(opts.paths.outputs, print_stats=True)
         # outputs/ ends as exactly the kept subset of what was measured:
         # every snapshot file's content was replayed (directly or via a

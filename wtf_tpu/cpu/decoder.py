@@ -38,8 +38,10 @@ from wtf_tpu.cpu.uops import (
     REG_RIP, REP_NONE, REP_REP, REP_REPNE, SEG_FS, SEG_GS, SEG_NONE,
     SH_SHL, SH_SHLD, SH_SHRD, SSE_PADDB, SSE_PAND, SSE_PANDN, SSE_PCMPEQB,
     SSE_PCMPEQD,
-    SSE_PCMPEQW, SSE_PMINUB, SSE_PMOVMSKB, SSE_POR, SSE_PSHUFD, SSE_PSLLDQ,
-    SSE_PSRLDQ, SSE_PSUBB, SSE_PTEST, SSE_PUNPCKLQDQ, SSE_PXOR, SSE_XORPS, STR_CMPS,
+    SSE_PCMPEQW, SSE_PMINUB, SSE_PMOVMSKB, SSE_PADDQ, SSE_POR, SSE_PSHUFD,
+    SSE_PSLLDQ,
+    SSE_PSRLDQ, SSE_PSUBB, SSE_PTEST, SSE_PUNPCKLDQ, SSE_PUNPCKLQDQ, SSE_PXOR,
+    SSE_XORPS, STR_CMPS,
     STR_LODS, STR_MOVS, STR_SCAS, STR_STOS, UN_DEC, UN_INC, UN_NEG, UN_NOT,
     Uop,
 )
@@ -270,7 +272,10 @@ def _decode_prefixes(cur: _Cursor) -> _Prefixes:
             pass  # es/cs/ss/ds overrides are no-ops in long mode
         else:
             break
-        pfx.any_legacy = True
+        # only LOCK/66/F2/F3 #UD a following VEX; segment overrides are
+        # legal before VEX (they scope its memory operand)
+        if b in (0x66, 0xF0, 0xF2, 0xF3):
+            pfx.any_legacy = True
         cur.pos += 1
     b = cur.peek()
     if 0x40 <= b <= 0x4F:
@@ -380,6 +385,9 @@ def _decode_vex(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         uop.opc = OPC_INVALID
         return
     if mmmmm == 3 and opc == 0xF0 and pp == 3:  # rorx r, r/m, imm8
+        if vvvv != 0:  # encoded VEX.vvvv must be 1111b (hardware #UD)
+            uop.opc = OPC_INVALID
+            return
         uop.opc, uop.sub, uop.opsize = OPC_PEXT, BMI_RORX, opsize
         modrm = _ModRM(cur, pfx)
         _reg_operand(uop, modrm, pfx, is_dst=True)
@@ -1098,6 +1106,12 @@ def _decode_0f_sse(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         0x76: SSE_PCMPEQD, 0xF8: SSE_PSUBB, 0xFC: SSE_PADDB,
         0xDA: SSE_PMINUB, 0x6C: SSE_PUNPCKLQDQ,
     }
+    if op in (0x62, 0xD4):  # punpckldq / paddq: 66-prefixed only (no MMX)
+        if not pfx.osize:
+            uop.opc = OPC_INVALID
+            return
+        sse_table[0x62] = SSE_PUNPCKLDQ
+        sse_table[0xD4] = SSE_PADDQ
     if op in sse_table:
         uop.opc, uop.sub = OPC_SSEALU, sse_table[op]
         uop.opsize = 16

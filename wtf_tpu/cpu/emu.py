@@ -1150,6 +1150,14 @@ class EmuCpu:
             out = bytes(min(a, b) for a, b in zip(dst, src))
         elif sub == U.SSE_PUNPCKLQDQ:
             out = dst[:8] + src[:8]
+        elif sub == U.SSE_PUNPCKLDQ:
+            out = dst[:4] + src[:4] + dst[4:8] + src[4:8]
+        elif sub == U.SSE_PADDQ:
+            out = b"".join(
+                ((int.from_bytes(dst[i:i + 8], "little")
+                  + int.from_bytes(src[i:i + 8], "little"))
+                 & MASK64).to_bytes(8, "little")
+                for i in (0, 8))
         elif sub == U.SSE_PSHUFD:
             sel = uop.imm
             out = b"".join(

@@ -68,12 +68,13 @@ class Corpus:
         return corpus
 
 
-def seed_paths(dirs) -> List[Tuple[Path, str]]:
+def seed_paths(dirs, with_data: bool = False) -> List[tuple]:
     """Seed files from one or more directories as (path, content digest)
-    pairs, size-sorted biggest first and content-deduped (the reference
-    master's replay ordering, server.h:399-414) — the ONE implementation
-    of that policy.  Bytes are read transiently for digesting; files
-    vanishing mid-scan are skipped."""
+    pairs — (path, digest, bytes) triples when `with_data` — size-sorted
+    biggest first and content-deduped (the reference master's replay
+    ordering, server.h:399-414): the ONE implementation of that policy.
+    Without `with_data`, bytes are read transiently for digesting; files
+    vanishing mid-scan are skipped either way."""
     sized = []
     for d in dirs:
         if not (d and Path(d).is_dir()):
@@ -87,10 +88,11 @@ def seed_paths(dirs) -> List[Tuple[Path, str]]:
     seen, out = set(), []
     for _, p in sorted(sized, key=lambda t: t[0], reverse=True):
         try:
-            digest = hex_digest(p.read_bytes())
+            data = p.read_bytes()
         except OSError:
             continue  # vanished mid-scan
+        digest = hex_digest(data)
         if digest not in seen:
             seen.add(digest)
-            out.append((p, digest))
+            out.append((p, digest, data) if with_data else (p, digest))
     return out

@@ -904,13 +904,17 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     pslldq_n = jnp.minimum(imm, _u(16)).astype(jnp.int32)
     psll_idx = jnp.clip(i16u - pslldq_n, 0, 15)
     psrl_idx = jnp.clip(i16u + pslldq_n, 0, 15)
+    # punpckldq: interleave the low dwords -> [a0 b0 a1 b1] (dword units)
+    punp_src_b = (i16u // 4) & 1  # odd dword slots come from src
+    punp_idx = ((i16u // 8) * 4) + (i16u % 4)
     sse_bytes = jnp.select(
         [sub == U.SSE_PXOR, sub == U.SSE_XORPS, sub == U.SSE_POR,
          sub == U.SSE_PAND, sub == U.SSE_PANDN,
          sub == U.SSE_PCMPEQB, sub == U.SSE_PCMPEQW, sub == U.SSE_PCMPEQD,
          sub == U.SSE_PSUBB, sub == U.SSE_PADDB, sub == U.SSE_PMINUB,
          sub == U.SSE_PUNPCKLQDQ, sub == U.SSE_PSHUFD,
-         sub == U.SSE_PSLLDQ, sub == U.SSE_PSRLDQ],
+         sub == U.SSE_PSLLDQ, sub == U.SSE_PSRLDQ,
+         sub == U.SSE_PUNPCKLDQ],
         [ba ^ bb, ba ^ bb, ba | bb, ba & bb, (~ba) & bb,
          jnp.where(eq_b, jnp.uint8(0xFF), jnp.uint8(0)),
          jnp.where(eq_w16, jnp.uint8(0xFF), jnp.uint8(0)),
@@ -919,9 +923,14 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
          jnp.where(i16u < 8, ba, bb[jnp.clip(i16u - 8, 0, 15)]),
          bb[pshufd_idx],
          jnp.where(i16u >= pslldq_n, ba[psll_idx], jnp.uint8(0)),
-         jnp.where(i16u + pslldq_n < 16, ba[psrl_idx], jnp.uint8(0))],
+         jnp.where(i16u + pslldq_n < 16, ba[psrl_idx], jnp.uint8(0)),
+         jnp.where(punp_src_b == 0, ba[punp_idx], bb[punp_idx])],
         default=ba)
     sse_out_lo, sse_out_hi = _pack_pair(sse_bytes)
+    # paddq works on the u64 limbs directly (byte-wise adds lose carries)
+    is_paddq = is_ssealu & (sub == U.SSE_PADDQ)
+    sse_out_lo = jnp.where(is_paddq, x_dst_lo + x_src_lo, sse_out_lo)
+    sse_out_hi = jnp.where(is_paddq, x_dst_hi + x_src_hi, sse_out_hi)
     # pmovmskb: sign bit of each src byte -> gpr bit i
     bsrc_msk = _unpack_bytes(xmm[jnp.clip(sr, 0, 15), 0],
                              xmm[jnp.clip(sr, 0, 15), 1])
