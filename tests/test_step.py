@@ -247,6 +247,17 @@ DIFF_CASES = [
         movdqu [rbx+32], xmm0
         movdqu [rbx+48], xmm2
         hlt""", {DATA_BASE: bytes(range(200, 232)) + b"\x00" * 0x100}),
+    ("sse_movlps_movhps", f"""
+        mov rbx, {DATA_BASE}
+        movdqu xmm0, [rbx]
+        movlps xmm0, [rbx+16]
+        movhps xmm0, [rbx+24]
+        movdqu xmm1, [rbx+32]
+        movhlps xmm1, xmm0
+        movlhps xmm1, xmm0
+        movlps [rbx+48], xmm0
+        movhps [rbx+56], xmm1
+        hlt""", {DATA_BASE: bytes(range(64, 128)) + b"\x00" * 0x100}),
     ("sse_movq_movd", f"""
         mov rax, 0x1122334455667788
         movq xmm0, rax

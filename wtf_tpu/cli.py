@@ -280,28 +280,20 @@ def cmd_campaign(args) -> int:
         # reference semantics (server.h:552-556): replay the seeds — plus
         # any prior campaign's outputs/, so a corpus can minimize itself —
         # and leave outputs/ holding exactly the coverage-minimal subset.
-        # seed the corpus through the shared replay-ordering policy
-        # (size-sorted, content-deduped — minset's minimality depends on
-        # it), reading+digesting each seed exactly once
+        # ONE walk feeds both the corpus (through the shared size-sorted
+        # replay-ordering policy; add_digested dedups) and the prune
+        # snapshot (pre-dedup census of outputs/); files appearing after
+        # this walk were never measured and stay untouched
         from wtf_tpu.fuzz.corpus import seed_paths
-        from wtf_tpu.utils.hashing import hex_digest
 
-        for _, digest, data in seed_paths(
-                [opts.paths.inputs, opts.paths.outputs], with_data=True):
-            corpus.add_digested(data, digest)
-        # prune candidates: every pre-existing outputs file (pre-dedup —
-        # content-duplicate files must all be caught); files appearing
-        # after this walk were never measured and stay untouched
-        outputs_snapshot = []
         out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
-        if out_dir and out_dir.is_dir():
-            for p in out_dir.iterdir():
-                if p.is_file():
-                    try:
-                        outputs_snapshot.append(
-                            (p, hex_digest(p.read_bytes())))
-                    except OSError:
-                        continue
+        outputs_snapshot = []
+        for p, digest, data in seed_paths(
+                [opts.paths.inputs, opts.paths.outputs],
+                with_data=True, keep_dups=True):
+            corpus.add_digested(data, digest)
+            if out_dir and p.parent == out_dir:
+                outputs_snapshot.append((p, digest))
         kept = loop.minset(opts.paths.outputs, print_stats=True)
         # outputs/ ends as exactly the kept subset of what was measured:
         # every snapshot file's content was replayed (directly or via a

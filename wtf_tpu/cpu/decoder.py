@@ -1033,6 +1033,32 @@ def _decode_0f_sse(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         else:
             uop.src_kind, uop.src_reg = K_XMM, modrm.reg
 
+    # movlps/movhps family (66 = movlpd/movhpd, integer-identical; the
+    # F3/F2 forms movsldup/movddup are out of the subset).  sub 4 = low
+    # qword, sub 5 = high qword; reg forms are movhlps (src HIGH -> dst
+    # low) and movlhps (src LOW -> dst high).
+    if op in (0x12, 0x13, 0x16, 0x17):
+        if pfx.rep or pfx.repne:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc = OPC_SSEMOV
+        uop.opsize = 8
+        uop.sub = 4 if op in (0x12, 0x13) else 5
+        modrm = _ModRM(cur, pfx)
+        if op in (0x12, 0x16):  # load (or reg-to-reg half move)
+            if not modrm.is_mem and pfx.osize:
+                uop.opc = OPC_INVALID  # movlpd/movhpd require memory
+                return
+            xmm_reg(modrm, is_dst=True)
+            xmm_rm(modrm, is_dst=False)
+        else:                   # store: memory only
+            if not modrm.is_mem:
+                uop.opc = OPC_INVALID
+                return
+            xmm_rm(modrm, is_dst=True)
+            xmm_reg(modrm, is_dst=False)
+        return
+
     # movups/movupd/movss/movsd and movaps/movapd (alignment not enforced)
     if op in (0x10, 0x28):
         uop.opc = OPC_SSEMOV

@@ -1081,6 +1081,21 @@ class EmuCpu:
                 self.write_reg(uop.dst_reg, size,
                                int.from_bytes(data, "little"))
             return
+        if uop.sub in (4, 5):  # movlps/movhps family: one qword half
+            hi = uop.sub == 5
+            if uop.dst_kind == U.K_MEM:  # store the chosen half
+                data = self._read_xmm_bytes(uop.src_reg, 16)
+                self.virt_write(ea, data[8:] if hi else data[:8])
+                return
+            if uop.src_kind == U.K_MEM:
+                half = self.virt_read(ea, 8)
+            else:  # movhlps takes src HIGH; movlhps takes src LOW
+                sdata = self._read_xmm_bytes(uop.src_reg, 16)
+                half = sdata[:8] if hi else sdata[8:]
+            dst = self._read_xmm_bytes(uop.dst_reg, 16)
+            out = (dst[:8] + half) if hi else (half + dst[8:])
+            self._write_xmm_bytes(uop.dst_reg, out, merge=False)
+            return
         # plain moves
         if uop.src_kind == U.K_XMM:
             data = self._read_xmm_bytes(uop.src_reg, size)

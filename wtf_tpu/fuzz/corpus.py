@@ -68,11 +68,14 @@ class Corpus:
         return corpus
 
 
-def seed_paths(dirs, with_data: bool = False) -> List[tuple]:
+def seed_paths(dirs, with_data: bool = False,
+               keep_dups: bool = False) -> List[tuple]:
     """Seed files from one or more directories as (path, content digest)
     pairs — (path, digest, bytes) triples when `with_data` — size-sorted
     biggest first and content-deduped (the reference master's replay
     ordering, server.h:399-414): the ONE implementation of that policy.
+    `keep_dups` keeps content-duplicate files in the listing (callers
+    that also need the full directory census, e.g. minset pruning).
     Without `with_data`, bytes are read transiently for digesting; files
     vanishing mid-scan are skipped either way."""
     sized = []
@@ -92,7 +95,7 @@ def seed_paths(dirs, with_data: bool = False) -> List[tuple]:
         except OSError:
             continue  # vanished mid-scan
         digest = hex_digest(data)
-        if digest not in seen:
+        if keep_dups or digest not in seen:
             seen.add(digest)
             out.append((p, digest, data) if with_data else (p, digest))
     return out

@@ -885,6 +885,12 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     ssm_hi = jnp.where(opsize >= 16, ssm_in_hi,
                        jnp.where(ssm_merge, x_dst_hi, _u(0)))
     ssm_hi = jnp.where(sub == 1, _u(0), ssm_hi)
+    # movlps/movhps family (sub 4 = low half, 5 = high half): memory loads
+    # take l1; reg forms cross halves (movhlps: src HIGH, movlhps: src LOW)
+    half4 = jnp.where(sk == U.K_XMM, x_src_hi, l1_lo)
+    half5 = jnp.where(sk == U.K_XMM, x_src_lo, l1_lo)
+    ssm_lo = jnp.where(sub == 4, half4, jnp.where(sub == 5, x_dst_lo, ssm_lo))
+    ssm_hi = jnp.where(sub == 4, x_dst_hi, jnp.where(sub == 5, half5, ssm_hi))
 
     # byte-level SSE ALU on unpacked u8[16] vectors
     ba = _unpack_bytes(x_dst_lo, x_dst_hi)
@@ -1104,7 +1110,10 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_pushf, rf | _u(0x2)),
         (s_stos, rax_op),
         (s_movs, l1_lo),
-        (is_ssemov, xmm[jnp.clip(sr, 0, 15), 0]),
+        # movhps-store (sub 5) writes the HIGH xmm limb; everything else
+        # in the class stores from the low limb
+        (is_ssemov, jnp.where(sub == 5, xmm[jnp.clip(sr, 0, 15), 1],
+                              xmm[jnp.clip(sr, 0, 15), 0])),
     ], _u(0))
     st_hi = jnp.where(is_ssemov, xmm[jnp.clip(sr, 0, 15), 1],
                       jnp.where(s_movs, l1_hi, _u(0)))
