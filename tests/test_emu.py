@@ -136,6 +136,7 @@ HW_CASES = [
     ("blsmsk", "blsmsk rax, rbx", 0x881),
     ("blsi", "blsi rax, rbx", 0x8C1),
     ("blsi_zero", "xor rbx, rbx\nblsi rax, rbx", 0x8C1),
+    ("vzeroupper", "vzeroupper", FLAGS_MASK),  # no-op in this model
 ]
 
 _INIT_REGS = [
@@ -574,3 +575,9 @@ def test_vex_after_prefix_is_invalid():
     # rorx requires encoded VEX.vvvv == 1111b; hardware #UDs otherwise
     assert decode(bytes([0xC4, 0xE3, 0x43, 0xF0, 0xC3, 0x0D]) +
                   b"\x90" * 8).opc == OPC_INVALID
+    # vzeroupper is strict too: pp != 0 or vvvv != 1111b #UDs
+    from wtf_tpu.cpu.uops import OPC_NOP
+
+    assert decode(bytes([0xC5, 0xF8, 0x77]) + b"\x90" * 8).opc == OPC_NOP
+    assert decode(bytes([0xC5, 0xF9, 0x77]) + b"\x90" * 8).opc == OPC_INVALID
+    assert decode(bytes([0xC5, 0xB8, 0x77]) + b"\x90" * 8).opc == OPC_INVALID

@@ -23,9 +23,12 @@ _NTEL = 0x6C65746E  # "ntel"
 _L1_EDX = (1 << 0) | (1 << 4) | (1 << 5) | (1 << 6) | (1 << 8) | (1 << 11) \
     | (1 << 13) | (1 << 15) | (1 << 19) | (1 << 23) | (1 << 24) | (1 << 25) \
     | (1 << 26)
-# Leaf 1 ECX: SSE3|SSSE3|CX16|SSE4.1|SSE4.2|POPCNT  (no OSXSAVE/AVX/RDRAND —
-# RDRAND is still executed deterministically if code probes it blindly)
-_L1_ECX = (1 << 0) | (1 << 9) | (1 << 13) | (1 << 19) | (1 << 20) | (1 << 23)
+# Leaf 1 ECX: POPCNT only.  SSE3/SSSE3/SSE4.x are NOT advertised — their
+# instruction sets (movddup, palignr, pcmpistri, ...) are outside the
+# implemented subset, so feature-dispatched guests (glibc ifunc etc.) must
+# take the SSE2 paths both executors cover.  No OSXSAVE/AVX/RDRAND either
+# (RDRAND still executes deterministically if code probes it blindly).
+_L1_ECX = 1 << 23
 
 CPUID_TABLE: Dict[Tuple[int, int], Tuple[int, int, int, int]] = {
     (0x0, 0): (0x0000000D, _GENU, _NTEL, _INEI),

@@ -340,6 +340,14 @@ def _decode_vex(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
     pfx.rex = (w << 3) | (r << 2) | (x << 1) | b
     opsize = 8 if w else 4
 
+    if (mmmmm == 1 and opc == 0x77 and not l_bit
+            and pp == 0 and vvvv == 0):
+        # vzeroupper (pp/vvvv must be 0 — hardware #UDs otherwise): no
+        # YMM state in this machine model -> architectural no-op
+        # (compilers emit it at AVX/SSE transition points)
+        uop.opc = OPC_NOP
+        return
+
     if l_bit:  # VEX.256 (AVX) — not in the scalar subset
         uop.opc = OPC_INVALID
         return
