@@ -384,9 +384,14 @@ class Runner:
         # status drops back to the base size for responsive servicing.
         # Sizes are sparse (x16) to bound the number of XLA compiles.
         self.adaptive_chunks = True
+        # x16 growth rungs, min-capped so the TOP rung always reaches
+        # 65536 (the plain x16 ladder stops short for most bases — e.g.
+        # 512 -> 8192 — and a deep execution, BASELINE config 5's 100M
+        # instructions, then pays 8x the host round trips)
         self._chunk_sizes = [chunk_steps]
-        while self._chunk_sizes[-1] * 16 <= (1 << 16):
-            self._chunk_sizes.append(self._chunk_sizes[-1] * 16)
+        while self._chunk_sizes[-1] < (1 << 16):
+            self._chunk_sizes.append(
+                min(self._chunk_sizes[-1] * 16, 1 << 16))
         self._chunk_level = 0
         # run statistics (reference PrintRunStats role, backend.h:218)
         self.stats = {
