@@ -66,3 +66,12 @@ def test_deep_timeout_is_instruction_precise():
     assert isinstance(res[1], Ok)
     icount = np.asarray(backend.runner.machine.icount)
     assert int(icount[0]) == limit
+
+
+def test_chunk_ladder_reaches_cap():
+    """The adaptive-chunk ladder's top rung must reach 65536 for any base
+    (a short ladder costs deep executions 8x the host round trips)."""
+    for base in (8, 64, 256, 512, 4096):
+        backend = make_backend("tpu", n_lanes=2, chunk_steps=base)
+        assert backend.runner._chunk_sizes[-1] == 1 << 16, (
+            base, backend.runner._chunk_sizes)
