@@ -171,20 +171,24 @@ def main() -> None:
     # attempts get a bounded slice and a timed-out first attempt skips
     # the retry (a hung tunnel stays hung; only init errors are flaky).
     budget = float(os.environ.get("BENCH_TIMEOUT", "1800"))
+    deadline = time.time() + budget
     native_tmo = min(420.0, budget / 3)
     attempts = [
-        ({}, native_tmo),          # native platform (tpu when available)
-        ({}, native_tmo),          # retry once: tunnel init ERRORS are flaky
-        # degraded cpu fallback gets the remainder — the sum never
-        # exceeds the budget, so the outer driver cannot kill us before
-        # the guaranteed JSON line
-        ({"BENCH_PLATFORM": "cpu"}, budget - 2 * native_tmo),
+        ({}, native_tmo),   # native platform (tpu when available)
+        ({}, native_tmo),   # retry once: tunnel init ERRORS are flaky
+        # degraded cpu fallback gets whatever the budget has left (incl.
+        # the slice a skipped retry freed) — the sum never exceeds the
+        # budget, so the outer driver cannot kill us before the
+        # guaranteed JSON line
+        ({"BENCH_PLATFORM": "cpu"}, None),
     ]
     last_err = "no attempts ran"
     native_timed_out = False
     for i, (extra_env, tmo) in enumerate(attempts):
         if i == 1 and native_timed_out:
             continue  # hung tunnel: go straight to the cpu fallback
+        if tmo is None:
+            tmo = max(deadline - time.time(), 60.0)
         env = dict(os.environ, **extra_env)
         try:
             proc = subprocess.run(
