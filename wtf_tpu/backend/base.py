@@ -128,23 +128,45 @@ class Backend(abc.ABC):
         backend.h:255; page-granular identity here)."""
         return gpa
 
+    def inject_exception(self, vector: int, error_code: int = 0,
+                         cr2: Optional[int] = None) -> None:
+        """Vector an exception through the guest IDT on the current lane
+        (reference `bochscpu_cpu_set_exception`, bochscpu_backend.cc:995-998
+        / KVM event injection, kvm_backend.cc:2019-2042).  Raises the
+        delivery error when the snapshot's IDT cannot service it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement inject_exception")
+
     def page_faults_memory_if_needed(self, gva: int, size: int) -> bool:
         """Reference PageFaultsMemoryIfNeeded (backend.h:261,
-        bochscpu_backend.cc:917-999): inject #PF so the GUEST pages
-        memory in before a host write.  This design has no demand paging
-        — every snapshot page is materialized — so the check degenerates
-        to 'is the whole range mapped': True when the host may write it,
-        False when only guest execution (taking the real fault) could.
-        """
+        bochscpu_backend.cc:917-999): when part of [gva, gva+size) is not
+        yet paged in (lazy VirtualAlloc-style PTEs), inject a #PF so the
+        GUEST kernel pages it in, and return True — the calling breakpoint
+        handler must then return and let the guest run; the breakpoint
+        re-fires at the retried instruction and the range is probed again
+        (one page faulted in per round, exactly the reference's dance).
+        Returns False when the whole range is mapped and the host may
+        write it directly."""
+        from wtf_tpu.cpu.emu import MemFault
+        from wtf_tpu.cpu.interrupts import PF_ERR_U, PF_ERR_W
+        from wtf_tpu.interp.runner import HostFault
+
         page = 0x1000
         gva_end = gva + max(size, 1)
         pos = gva & ~(page - 1)
-        try:
-            while pos < gva_end:
+        page_to_fault = None
+        while pos < gva_end:
+            try:
                 self.virt_translate(pos, write=True)
-                pos += page
-        except Exception:
+            except (MemFault, HostFault):
+                page_to_fault = pos
+                break
+            pos += page
+        if page_to_fault is None:
             return False
+        # ErrorWrite | ErrorUser, like the reference's synthetic fault
+        # (bochscpu_backend.cc:993-998)
+        self.inject_exception(14, PF_ERR_W | PF_ERR_U, cr2=page_to_fault)
         return True
 
     def virt_read_u64(self, gva: int) -> int:

@@ -18,15 +18,24 @@ from wtf_tpu.core.results import (
 from wtf_tpu.cpu.emu import (
     DivideError, EmuCpu, EmuMem, GuestCrash, MemFault, UnsupportedInsn,
 )
+from wtf_tpu.cpu.interrupts import (
+    VEC_DE, VEC_PF, DeliveryFailed, deliver_page_fault,
+)
 from wtf_tpu.snapshot.loader import Snapshot
 from wtf_tpu.utils.hashing import splitmix64
 
 
 class EmuBackend(Backend):
-    def __init__(self, snapshot: Snapshot, limit: int = 0):
+    def __init__(self, snapshot: Snapshot, limit: int = 0,
+                 deliver_exceptions: Optional[bool] = None):
         self.snapshot = snapshot
         self.symbols = snapshot.symbols
         self.limit = limit
+        # Guest exception delivery through the snapshot's IDT (auto: on
+        # exactly when the snapshot carries one) — see cpu/interrupts.py.
+        if deliver_exceptions is None:
+            deliver_exceptions = snapshot.cpu.idtr.limit > 0
+        self.deliver_exceptions = deliver_exceptions
         self.breakpoints: Dict[int, BreakpointHandler] = {}
         self.cpu: Optional[EmuCpu] = None
         self._stop_result: Optional[TestcaseResult] = None
@@ -86,6 +95,8 @@ class EmuBackend(Backend):
                     result = Crash(f"crash-int-{e.rip:#x}")
                     break
                 except MemFault as e:
+                    if self._deliver(VEC_PF, fault=e):
+                        continue  # guest services the fault and retries
                     # execute-refinement: a fault on the fetch address is an
                     # exec A/V (reference refines A/Vs into read/write/
                     # execute, crash_detection_umode.cc:104-121)
@@ -96,6 +107,8 @@ class EmuBackend(Backend):
                     result = Crash(f"crash-{kind}-{e.gva:#x}")
                     break
                 except DivideError:
+                    if self._deliver(VEC_DE):
+                        continue
                     result = Crash(f"crash-de-{rip:#x}")
                     break
                 except UnsupportedInsn as e:
@@ -120,6 +133,34 @@ class EmuBackend(Backend):
         self._last_new = self._run_cov - self._aggregate_cov
         self._aggregate_cov |= self._last_new
         return result
+
+    def _deliver(self, vector: int, fault: Optional[MemFault] = None) -> bool:
+        """Try to vector a hardware fault through the guest IDT
+        (cpu/interrupts.py); False keeps the pre-delivery terminal-crash
+        behavior (no IDT, absent gate, or the delivery itself faulted —
+        the double-fault analog)."""
+        if not self.deliver_exceptions:
+            return False
+        cpu = self.cpu
+        try:
+            if vector == VEC_PF:
+                def reads(g):
+                    try:
+                        cpu.translate(g, write=False)
+                        return True
+                    except MemFault:
+                        return False
+
+                deliver_page_fault(cpu, fault.gva, fault.write, reads)
+            else:
+                cpu.deliver_exception(vector)
+        except (DeliveryFailed, MemFault):
+            return False
+        return True
+
+    def inject_exception(self, vector: int, error_code: int = 0,
+                         cr2: Optional[int] = None) -> None:
+        self.cpu.deliver_exception(vector, error_code, cr2)
 
     def _tenet_step(self, writer) -> None:
         """Post-instruction tenet delta: registers + the step's accesses

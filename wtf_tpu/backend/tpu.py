@@ -277,9 +277,12 @@ class TpuBackend(Backend):
         cpu.gpr = [int(v) for v in view.r["gpr"][0]]
         cpu.rip = int(view.r["rip"][0])
         cpu.rflags = int(view.r["rflags"][0])
-        for name in ("fs_base", "gs_base", "kernel_gs_base", "cr0", "cr3",
-                     "cr4", "cr8", "lstar", "star", "sfmask", "efer", "tsc"):
+        for name in ("fs_base", "gs_base", "kernel_gs_base", "cr0", "cr2",
+                     "cr3", "cr4", "cr8", "lstar", "star", "sfmask", "efer",
+                     "tsc"):
             setattr(cpu, name, int(view.r[name][0]))
+        cpu.cs_sel = int(view.r["cs"][0])
+        cpu.ss_sel = int(view.r["ss"][0])
         for i in range(16):
             cpu.xmm[i][0] = int(view.r["xmm"][0, i, 0])
             cpu.xmm[i][1] = int(view.r["xmm"][0, i, 1])
@@ -315,6 +318,14 @@ class TpuBackend(Backend):
 
     def virt_translate(self, gva: int, write: bool = False) -> int:
         return self._ensure_view().translate(self._lane, gva, write)
+
+    def inject_exception(self, vector: int, error_code: int = 0,
+                         cr2: Optional[int] = None) -> None:
+        from wtf_tpu.cpu.interrupts import deliver_exception
+        from wtf_tpu.interp.runner import _LaneCtx
+
+        ctx = _LaneCtx(self._ensure_view(), self._lane, self.snapshot.cpu)
+        deliver_exception(ctx, vector, error_code, cr2)
 
     def virt_read(self, gva: int, size: int) -> bytes:
         return self._ensure_view().virt_read(self._lane, gva, size)

@@ -136,8 +136,11 @@ class EmuCpu:
         self.rflags = 0x2
         self.cr3 = 0
         self.cr0 = 0
+        self.cr2 = 0
         self.cr4 = 0
         self.cr8 = 0
+        self.cs_sel = 0
+        self.ss_sel = 0
         self.fs_base = 0
         self.gs_base = 0
         self.kernel_gs_base = 0
@@ -163,8 +166,11 @@ class EmuCpu:
         self.rflags = state.rflags | 0x2
         self.cr3 = state.cr3
         self.cr0 = state.cr0
+        self.cr2 = state.cr2
         self.cr4 = state.cr4
         self.cr8 = state.cr8
+        self.cs_sel = state.cs.selector
+        self.ss_sel = state.ss.selector
         self.fs_base = state.fs.base
         self.gs_base = state.gs.base
         self.kernel_gs_base = state.kernel_gs_base
@@ -252,6 +258,49 @@ class EmuCpu:
 
     def write_u(self, gva: int, size: int, value: int) -> None:
         self.virt_write(gva, (value & ((1 << (size * 8)) - 1)).to_bytes(size, "little"))
+
+    # -- exception-delivery ctx surface (cpu/interrupts.py) --------------
+    # IDTR/TR come from the snapshot: lidt/ltr are not emulated, so the
+    # tables a snapshot was taken with stay authoritative for its lifetime
+    # (true of the reference too — bochs loads them once from CpuState_t).
+    @property
+    def rsp(self) -> int:
+        return self.gpr[4]
+
+    @rsp.setter
+    def rsp(self, value: int) -> None:
+        self.gpr[4] = value & MASK64
+
+    @property
+    def idt_base(self) -> int:
+        return self.snapshot.idtr.base
+
+    @property
+    def idt_limit(self) -> int:
+        return self.snapshot.idtr.limit
+
+    @property
+    def tss_base(self) -> int:
+        return self.snapshot.tr.base
+
+    def read_virt(self, gva: int, size: int) -> bytes:
+        return self.virt_read(gva, size)
+
+    def read_u64(self, gva: int) -> int:
+        return self.read_u(gva, 8)
+
+    def write_u64(self, gva: int, value: int) -> None:
+        self.write_u(gva, 8, value)
+
+    def set_cr2(self, value: int) -> None:
+        self.cr2 = value & MASK64
+
+    def deliver_exception(self, vector: int, error_code: int = 0,
+                          cr2=None) -> None:
+        """Vector a fault through the guest IDT (cpu/interrupts.py)."""
+        from wtf_tpu.cpu.interrupts import deliver_exception
+
+        deliver_exception(self, vector, error_code, cr2)
 
     # -- flags ----------------------------------------------------------
     def get_flag(self, bit: int) -> bool:
@@ -466,9 +515,14 @@ class EmuCpu:
         elif opc == U.OPC_DIV:
             self._exec_div(uop, load_src(), bits)
         elif opc == U.OPC_PUSH:
+            # store before committing rsp: a faulting push must leave rsp
+            # untouched so the #PF-deliver-and-retry path (interrupts.py)
+            # re-executes it from pristine state, like the device path
+            # which gates all commits on ~page_fault
             val = load_src()
-            self.gpr[4] = (self.gpr[4] - opsize) & MASK64
-            self.write_u(self.gpr[4], opsize, val)
+            new_rsp = (self.gpr[4] - opsize) & MASK64
+            self.write_u(new_rsp, opsize, val)
+            self.gpr[4] = new_rsp
         elif opc == U.OPC_POP:
             val = self.read_u(self.gpr[4], opsize)
             self.gpr[4] = (self.gpr[4] + opsize) & MASK64
@@ -476,8 +530,9 @@ class EmuCpu:
         elif opc == U.OPC_CALL:
             target = (next_rip + uop.imm) & MASK64 if uop.src_kind == U.K_IMM \
                 else load_src()
-            self.gpr[4] = (self.gpr[4] - 8) & MASK64
-            self.write_u(self.gpr[4], 8, next_rip)
+            new_rsp = (self.gpr[4] - 8) & MASK64
+            self.write_u(new_rsp, 8, next_rip)  # may fault: commit after
+            self.gpr[4] = new_rsp
             self.rip = target
             return
         elif opc == U.OPC_RET:
@@ -485,21 +540,24 @@ class EmuCpu:
             self.gpr[4] = (self.gpr[4] + 8 + uop.imm) & MASK64
             return
         elif opc == U.OPC_IRET:
-            # iretq: pop rip, cs, rflags, rsp, ss (five qwords).  Flat
-            # memory model: segment selectors are accepted but not acted
-            # on (the OS swapgs-es before iretq itself; privilege lives in
-            # the page tables here).  Reference gets this from bochs/KVM.
+            # iretq: pop rip, cs, rflags, rsp, ss (five qwords).  The
+            # selectors track CPL for exception delivery (cpu/interrupts.py)
+            # but are not validated against the GDT — flat memory model,
+            # protection lives in the page tables.  Reference gets the full
+            # check from bochs/KVM.
             if uop.opsize != 8:
                 raise UnsupportedInsn(self.rip, uop.raw)  # iretd (no REX.W)
             rsp = self.gpr[4]
             new_rip = self.read_u(rsp, 8)
-            _cs = self.read_u(rsp + 8, 8)
+            new_cs = self.read_u(rsp + 8, 8)
             new_rflags = self.read_u(rsp + 16, 8)
             new_rsp = self.read_u(rsp + 24, 8)
-            _ss = self.read_u(rsp + 32, 8)
+            new_ss = self.read_u(rsp + 32, 8)
             self.rip = new_rip
             self.rflags = (new_rflags | 0x2) & U.RF_WRITABLE
             self.gpr[4] = new_rsp & MASK64
+            self.cs_sel = new_cs & 0xFFFF
+            self.ss_sel = new_ss & 0xFFFF
             return
         elif opc == U.OPC_JMP:
             self.rip = (next_rip + uop.imm) & MASK64 if uop.src_kind == U.K_IMM \
@@ -530,8 +588,9 @@ class EmuCpu:
         elif opc == U.OPC_BITSCAN:
             self._exec_bitscan(uop, load_src(), bits)
         elif opc == U.OPC_PUSHF:
-            self.gpr[4] = (self.gpr[4] - 8) & MASK64
-            self.write_u(self.gpr[4], 8, self.rflags | 0x2)
+            new_rsp = (self.gpr[4] - 8) & MASK64
+            self.write_u(new_rsp, 8, self.rflags | 0x2)  # may fault
+            self.gpr[4] = new_rsp
         elif opc == U.OPC_POPF:
             val = self.read_u(self.gpr[4], 8)
             self.gpr[4] = (self.gpr[4] + 8) & MASK64
@@ -666,10 +725,17 @@ class EmuCpu:
                 self.gpr[11] = self.rflags & ~0x10000        # r11 (RF clear)
                 self.rflags = (self.rflags & ~(self.sfmask | 0x100)) | 0x2
                 self.rip = self.lstar
+                # CS/SS from IA32_STAR[47:32] (SDM: SYSCALL loads CPL-0
+                # selectors; tracked for exception delivery)
+                self.cs_sel = (self.star >> 32) & 0xFFFC
+                self.ss_sel = ((self.star >> 32) & 0xFFFC) + 8
                 return
             else:  # sysret
                 self.rip = self.gpr[1]
                 self.rflags = (self.gpr[11] & U.RF_WRITABLE) | 0x2
+                # CS/SS from IA32_STAR[63:48] (SYSRET 64-bit forms)
+                self.cs_sel = (((self.star >> 48) & 0xFFFF) + 16) | 3
+                self.ss_sel = (((self.star >> 48) & 0xFFFF) + 8) | 3
                 return
         elif opc == U.OPC_RDGSBASE:
             if uop.sub == 4:  # swapgs
@@ -1029,14 +1095,16 @@ class EmuCpu:
     def _exec_movcr(self, uop) -> None:
         cr = uop.sub
         if uop.sext == 0:  # read
-            val = {0: self.cr0, 2: 0, 3: self.cr3, 4: self.cr4, 8: self.cr8} \
-                .get(cr)
+            val = {0: self.cr0, 2: self.cr2, 3: self.cr3, 4: self.cr4,
+                   8: self.cr8}.get(cr)
             if val is None:
                 raise UnsupportedInsn(self.rip, uop.raw)
             self.write_reg(uop.dst_reg, 8, val)
         else:
             val = self.read_reg(uop.src_reg, 8)
-            if cr == 3:
+            if cr == 2:
+                self.cr2 = val
+            elif cr == 3:
                 # recorded, not raised: rip still advances; the backend turns
                 # a differing cr3 into Cr3Change after the step (reference
                 # tlb_cntrl hook bochscpu_backend.cc:628-657)

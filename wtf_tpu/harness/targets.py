@@ -82,5 +82,5 @@ def load_builtin_targets() -> None:
     equivalent is importing the harness modules)."""
     from wtf_tpu.harness import (  # noqa: F401
         demo_fs, demo_ioctl, demo_kernel, demo_maze, demo_spin,
-        demo_tlv,
+        demo_tlv, demo_usermode,
     )

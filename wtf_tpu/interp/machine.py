@@ -40,9 +40,12 @@ class Machine(NamedTuple):
     gs_base: jax.Array    # uint64[L]
     kernel_gs_base: jax.Array  # uint64[L]
     cr0: jax.Array        # uint64[L]
+    cr2: jax.Array        # uint64[L] (set by host exception delivery)
     cr3: jax.Array        # uint64[L]
     cr4: jax.Array        # uint64[L]
     cr8: jax.Array        # uint64[L]
+    cs: jax.Array         # uint64[L] CS selector (CPL tracking for delivery)
+    ss: jax.Array         # uint64[L] SS selector
     lstar: jax.Array      # uint64[L]
     star: jax.Array       # uint64[L]
     sfmask: jax.Array     # uint64[L]
@@ -78,7 +81,8 @@ def cpu_vector(cpu: CpuState) -> np.ndarray:
         cpu.gpr_list()
         + [
             cpu.rip, cpu.rflags | 0x2, cpu.fs.base, cpu.gs.base,
-            cpu.kernel_gs_base, cpu.cr0, cpu.cr3, cpu.cr4, cpu.cr8,
+            cpu.kernel_gs_base, cpu.cr0, cpu.cr2, cpu.cr3, cpu.cr4,
+            cpu.cr8, cpu.cs.selector, cpu.ss.selector,
             cpu.lstar, cpu.star, cpu.sfmask, cpu.efer, cpu.tsc,
         ],
         dtype=np.uint64,
@@ -113,9 +117,12 @@ def machine_init(
         gs_base=bcast(cpu.gs.base),
         kernel_gs_base=bcast(cpu.kernel_gs_base),
         cr0=bcast(cpu.cr0),
+        cr2=bcast(cpu.cr2),
         cr3=bcast(cpu.cr3),
         cr4=bcast(cpu.cr4),
         cr8=bcast(cpu.cr8),
+        cs=bcast(cpu.cs.selector),
+        ss=bcast(cpu.ss.selector),
         lstar=bcast(cpu.lstar),
         star=bcast(cpu.star),
         sfmask=bcast(cpu.sfmask),

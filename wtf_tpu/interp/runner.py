@@ -38,6 +38,9 @@ from wtf_tpu.cpu import uops as U
 from wtf_tpu.cpu.emu import (
     DivideError, EmuCpu, GuestCrash, MemFault, UnsupportedInsn,
 )
+from wtf_tpu.cpu.interrupts import (
+    VEC_DE, DeliveryFailed, deliver_exception, deliver_page_fault,
+)
 from wtf_tpu.interp.machine import Machine, machine_init, machine_restore
 from wtf_tpu.interp.step import make_run_chunk
 from wtf_tpu.interp.uoptable import DecodeCache
@@ -53,7 +56,8 @@ PHYS_MASK = 0x000F_FFFF_FFFF_F000
 # Machine leaves mirrored into HostView (everything except overlay/cov/edge).
 _MIRROR_FIELDS = (
     "gpr", "rip", "rflags", "xmm", "fs_base", "gs_base", "kernel_gs_base",
-    "cr0", "cr3", "cr4", "cr8", "lstar", "star", "sfmask", "efer", "tsc",
+    "cr0", "cr2", "cr3", "cr4", "cr8", "cs", "ss",
+    "lstar", "star", "sfmask", "efer", "tsc",
     "status", "icount", "rdrand", "bp_skip", "fault_gva", "fault_write",
 )
 
@@ -223,6 +227,76 @@ class HostView:
             pos += chunk
 
 
+class _LaneCtx:
+    """Exception-delivery ctx (cpu/interrupts.py duck type) over one lane of
+    a HostView: register/memory mutations land in the view and reach the
+    device on the next push.  The IDT/TSS anchors come from the snapshot
+    CpuState (lidt/ltr are not emulated — same fixed-tables model as the
+    oracle)."""
+
+    def __init__(self, view: HostView, lane: int, snapshot_cpu: CpuState):
+        self.view = view
+        self.lane = lane
+        self.idt_base = snapshot_cpu.idtr.base
+        self.idt_limit = snapshot_cpu.idtr.limit
+        self.tss_base = snapshot_cpu.tr.base
+
+    # registers
+    @property
+    def rip(self) -> int:
+        return self.view.get_rip(self.lane)
+
+    @rip.setter
+    def rip(self, value: int) -> None:
+        self.view.set_rip(self.lane, value)
+
+    @property
+    def rsp(self) -> int:
+        return self.view.get_reg(self.lane, 4)
+
+    @rsp.setter
+    def rsp(self, value: int) -> None:
+        self.view.set_reg(self.lane, 4, value)
+
+    @property
+    def rflags(self) -> int:
+        return int(self.view.r["rflags"][self.lane])
+
+    @rflags.setter
+    def rflags(self, value: int) -> None:
+        self.view.r["rflags"][self.lane] = np.uint64(value & MASK64)
+
+    @property
+    def cs_sel(self) -> int:
+        return int(self.view.r["cs"][self.lane])
+
+    @cs_sel.setter
+    def cs_sel(self, value: int) -> None:
+        self.view.r["cs"][self.lane] = np.uint64(value & 0xFFFF)
+
+    @property
+    def ss_sel(self) -> int:
+        return int(self.view.r["ss"][self.lane])
+
+    @ss_sel.setter
+    def ss_sel(self, value: int) -> None:
+        self.view.r["ss"][self.lane] = np.uint64(value & 0xFFFF)
+
+    def set_cr2(self, value: int) -> None:
+        self.view.r["cr2"][self.lane] = np.uint64(value & MASK64)
+
+    # memory (through the lane's page tables; raises HostFault)
+    def read_virt(self, gva: int, size: int) -> bytes:
+        return self.view.virt_read(self.lane, gva, size)
+
+    def read_u64(self, gva: int) -> int:
+        return int.from_bytes(self.read_virt(gva, 8), "little")
+
+    def write_u64(self, gva: int, value: int) -> None:
+        self.view.virt_write(
+            self.lane, gva, (value & MASK64).to_bytes(8, "little"))
+
+
 class _FallbackMem:
     """EmuMem-compatible adapter running the EmuCpu oracle against one
     lane's HostView (slow-path single-stepping for UNSUPPORTED uops)."""
@@ -264,9 +338,12 @@ def _lane_cpu_state(view: HostView, lane: int, snapshot_cpu: CpuState) -> CpuSta
     cpu.gs.base = int(view.r["gs_base"][lane])
     cpu.kernel_gs_base = int(view.r["kernel_gs_base"][lane])
     cpu.cr0 = int(view.r["cr0"][lane])
+    cpu.cr2 = int(view.r["cr2"][lane])
     cpu.cr3 = int(view.r["cr3"][lane])
     cpu.cr4 = int(view.r["cr4"][lane])
     cpu.cr8 = int(view.r["cr8"][lane])
+    cpu.cs.selector = int(view.r["cs"][lane])
+    cpu.ss.selector = int(view.r["ss"][lane])
     cpu.lstar = int(view.r["lstar"][lane])
     cpu.star = int(view.r["star"][lane])
     cpu.sfmask = int(view.r["sfmask"][lane])
@@ -286,9 +363,12 @@ def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
     view.r["gs_base"][lane] = np.uint64(cpu.gs_base & MASK64)
     view.r["kernel_gs_base"][lane] = np.uint64(cpu.kernel_gs_base & MASK64)
     view.r["cr0"][lane] = np.uint64(cpu.cr0 & MASK64)
+    view.r["cr2"][lane] = np.uint64(cpu.cr2 & MASK64)
     view.r["cr3"][lane] = np.uint64(cpu.cr3 & MASK64)
     view.r["cr4"][lane] = np.uint64(cpu.cr4 & MASK64)
     view.r["cr8"][lane] = np.uint64(cpu.cr8 & MASK64)
+    view.r["cs"][lane] = np.uint64(cpu.cs_sel & 0xFFFF)
+    view.r["ss"][lane] = np.uint64(cpu.ss_sel & 0xFFFF)
     # MSR-backed fields a wrmsr fallback may have rewritten
     view.r["lstar"][lane] = np.uint64(cpu.lstar & MASK64)
     view.r["star"][lane] = np.uint64(cpu.star & MASK64)
@@ -360,6 +440,7 @@ class Runner:
         overlay_slots: int = 128,
         edge_bits: int = 17,
         chunk_steps: int = 256,
+        deliver_exceptions: Optional[bool] = None,
     ):
         self.snapshot = snapshot
         self.physmem = snapshot.physmem
@@ -373,6 +454,13 @@ class Runner:
             edge_bits=edge_bits)
         self.limit = 0
         self.chunk_steps = chunk_steps
+        # Guest exception delivery (reference: every fault is serviced by
+        # the guest through bochs' IDT emulation / KVM event injection).
+        # Auto mode turns it on exactly when the snapshot carries an IDT;
+        # IDT-less synthetic guests keep the terminal-fault behavior.
+        if deliver_exceptions is None:
+            deliver_exceptions = snapshot.cpu.idtr.limit > 0
+        self.deliver_exceptions = deliver_exceptions
         self._run_chunk = make_run_chunk(chunk_steps)
         self.lane_errors: Dict[int, str] = {}
         self._smc_updates: Dict[int, int] = {}
@@ -396,7 +484,7 @@ class Runner:
         # run statistics (reference PrintRunStats role, backend.h:218)
         self.stats = {
             "chunks": 0, "decodes": 0, "fallbacks": 0, "smc_updates": 0,
-            "bp_dispatches": 0,
+            "bp_dispatches": 0, "exceptions_delivered": 0,
         }
 
     # -- host memory access ------------------------------------------------
@@ -526,6 +614,40 @@ class Runner:
         else:
             view.set_status(lane, StatusCode.RUNNING)
 
+    def _service_exception(self, view: HostView, lane: int) -> bool:
+        """Vector a faulted lane through the guest IDT (reference: bochs
+        delivers internally, bochscpu_backend.cc:917-999; KVM injects,
+        kvm_backend.cc:2019-2042).  On success the lane resumes RUNNING at
+        the guest handler; an undeliverable fault (absent gate, unmapped
+        IDT/TSS/kernel stack — the double-fault analog) keeps the lane's
+        terminal status and the crash naming that comes with it.  Returns
+        whether the exception was delivered."""
+        status = view.get_status(lane)
+        ctx = _LaneCtx(view, lane, self.cpu0)
+        try:
+            if status == StatusCode.PAGE_FAULT:
+                gva = int(view.r["fault_gva"][lane])
+                write = bool(view.r["fault_write"][lane])
+
+                def reads(g):
+                    try:
+                        view.translate(lane, g, write=False)
+                        return True
+                    except HostFault:
+                        return False
+
+                deliver_page_fault(ctx, gva, write, reads)
+            elif status == StatusCode.DIVIDE_ERROR:
+                deliver_exception(ctx, VEC_DE)
+            else:
+                return False
+        except (DeliveryFailed, HostFault) as e:
+            self.lane_errors.setdefault(lane, f"undelivered exception: {e}")
+            return False
+        self.stats["exceptions_delivered"] += 1
+        view.set_status(lane, StatusCode.RUNNING)
+        return True
+
     # -- run loop ----------------------------------------------------------
     def run(
         self,
@@ -541,6 +663,7 @@ class Runner:
         tab = self.cache.device()
         limit = jnp.uint64(self.limit)
         self._chunk_level = 0
+        undeliverable: Set[int] = set()  # lanes whose IDT delivery failed
         for _ in range(max_chunks):
             run_chunk = (make_run_chunk(self._chunk_sizes[self._chunk_level])
                          if self.adaptive_chunks else self._run_chunk)
@@ -555,7 +678,12 @@ class Runner:
                 int(StatusCode.UNSUPPORTED): [],
                 int(StatusCode.BREAKPOINT): [],
             }
+            if self.deliver_exceptions:
+                need[int(StatusCode.PAGE_FAULT)] = []
+                need[int(StatusCode.DIVIDE_ERROR)] = []
             for lane in np.nonzero(np.isin(status, list(need)))[0]:
+                if int(lane) in undeliverable:
+                    continue  # delivery already failed: stays terminal
                 need[int(status[lane])].append(int(lane))
             total = sum(len(v) for v in need.values())
             if total == 0:
@@ -575,6 +703,10 @@ class Runner:
                 self._service_smc(view, need[int(StatusCode.SMC)])
             for lane in need[int(StatusCode.UNSUPPORTED)]:
                 self._fallback_step(view, lane)
+            for lane in (need.get(int(StatusCode.PAGE_FAULT), [])
+                         + need.get(int(StatusCode.DIVIDE_ERROR), [])):
+                if not self._service_exception(view, lane):
+                    undeliverable.add(lane)
             for lane in need[int(StatusCode.BREAKPOINT)]:
                 self.stats["bp_dispatches"] += 1
                 if bp_handler is None:

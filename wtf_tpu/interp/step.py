@@ -859,7 +859,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     sysret_rf = (gpr[11] & _u(U.RF_WRITABLE)) | _u(0x2)
     cr_read = jnp.select(
         [sub == 0, sub == 2, sub == 3, sub == 4, sub == 8],
-        [st.cr0, _u(0), st.cr3, st.cr4, st.cr8], default=_u(0))
+        [st.cr0, st.cr2, st.cr3, st.cr4, st.cr8], default=_u(0))
     movcr_is_write = is_(U.OPC_MOVCR) & (sext_f != 0)
     cr_wval = _read_reg(gpr, sr, jnp.int32(8))
 
@@ -1187,6 +1187,19 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     new_gs = jnp.where(sw, st.kernel_gs_base, st.gs_base)
     new_kgs = jnp.where(sw, st.gs_base, st.kernel_gs_base)
 
+    # -- CS/SS selectors (CPL tracking for host exception delivery) -------
+    # SYSCALL loads CPL-0 selectors from IA32_STAR[47:32]; SYSRET the CPL-3
+    # pair from IA32_STAR[63:48] (SDM).  iretq restores them on the oracle.
+    sysc = commit & is_(U.OPC_SYSCALL)
+    star_k = (st.star >> _u(32)) & _u(0xFFFC)
+    star_u = (st.star >> _u(48)) & _u(0xFFFF)
+    new_cs = jnp.where(
+        sysc, jnp.where(syscall_entry, star_k, (star_u + _u(16)) | _u(3)),
+        st.cs)
+    new_ss = jnp.where(
+        sysc, jnp.where(syscall_entry, star_k + _u(8), (star_u + _u(8)) | _u(3)),
+        st.ss)
+
     # -- xmm ---------------------------------------------------------------
     wx_cond = commit & (
         (is_ssemov & (sub != 2) & (dk == U.K_XMM))
@@ -1257,6 +1270,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         gpr=new_gpr, rip=new_rip, rflags=new_rf, xmm=new_xmm,
         gs_base=new_gs, kernel_gs_base=new_kgs,
         cr0=new_cr0, cr3=new_cr3, cr4=new_cr4, cr8=new_cr8,
+        cs=new_cs, ss=new_ss,
         status=new_status, icount=new_icount, rdrand=new_rdrand,
         bp_skip=new_bp_skip, fault_gva=new_fault_gva,
         fault_write=new_fault_write, cov=new_cov, edge=new_edge,
