@@ -161,3 +161,19 @@ def test_cli_run_coverage_report(tmp_path, capsys):
                "--input", str(case), "--coverage", str(covdir)])
     assert rc == 0
     assert "coverage: 2/3 listed basic blocks hit" in capsys.readouterr().out
+
+
+def test_decode_pointer_matches_ntdll():
+    """DecodePointer/EncodePointer (reference utils.cc:302-304): the
+    rotate-xor round trip and a pinned vector."""
+    from wtf_tpu.core.nt import decode_pointer, encode_pointer
+
+    cookie = 0x00A1B2C3D4E5F607
+    for value in (0, 1, 0xFFFF_FFFF_FFFF_FFFF, 0x7FFE_0000_1234_5678):
+        assert decode_pointer(cookie, encode_pointer(cookie, value)) == value
+    # pinned: rotr(v, 0x40 - (c & 0x3F)) ^ c computed independently
+    value = 0x1122334455667788
+    rot = 0x40 - (cookie & 0x3F)
+    expect = (((value >> rot) | (value << (64 - rot)))
+              & (1 << 64) - 1) ^ cookie
+    assert decode_pointer(cookie, value) == expect

@@ -581,3 +581,28 @@ def test_vex_after_prefix_is_invalid():
     assert decode(bytes([0xC5, 0xF8, 0x77]) + b"\x90" * 8).opc == OPC_NOP
     assert decode(bytes([0xC5, 0xF9, 0x77]) + b"\x90" * 8).opc == OPC_INVALID
     assert decode(bytes([0xC5, 0xB8, 0x77]) + b"\x90" * 8).opc == OPC_INVALID
+
+
+def test_vzeroall_zeroes_xmm_state():
+    """vzeroall (VEX.256 0F 77) zeroes the FULL vector registers — XMM
+    state included — unlike vzeroupper (VEX.128), which is a true no-op in
+    this YMM-less machine model.  ADVICE r3: previously decoded INVALID
+    and produced a spurious invalid-opcode crash."""
+    from wtf_tpu.cpu.decoder import decode
+    from wtf_tpu.cpu.uops import OPC_NOP, OPC_VZEROALL
+
+    assert decode(bytes([0xC5, 0xFC, 0x77]) + b"\x90" * 8).opc == OPC_VZEROALL
+    assert decode(bytes([0xC5, 0xF8, 0x77]) + b"\x90" * 8).opc == OPC_NOP
+    cpu = run_emu("""
+        mov rax, 0x1122334455667788
+        movq xmm3, rax
+        movq xmm9, rax
+        vzeroupper
+        movq rbx, xmm3
+        vzeroall
+        movq rcx, xmm9
+        hlt
+    """)
+    assert cpu.gpr[3] == 0x1122334455667788  # vzeroupper kept xmm3
+    assert cpu.gpr[1] == 0                   # vzeroall cleared xmm9
+    assert all(cpu.xmm[i] == [0, 0] for i in range(16))

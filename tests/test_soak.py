@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from wtf_tpu.backend import create_backend
-from wtf_tpu.core.results import Crash, Ok, Timedout
+from wtf_tpu.core.results import Crash, Ok, OverlayFull, Timedout
 from wtf_tpu.harness import demo_spin, demo_tlv
 
 
@@ -30,9 +30,11 @@ def test_overlay_overflow_host_write_surfaces():
     assert statuses[1] == int(StatusCode.RUNNING)
 
 
-def test_overlay_overflow_guest_store_is_terminal_not_corrupting():
+def test_overlay_overflow_guest_store_is_distinct_result():
     """A lane whose guest stores need more pages than its overlay holds
-    parks as crash-overlay-full; siblings run; rerun is deterministic."""
+    parks as OverlayFull — a framework resource limit, NOT a Crash
+    (VERDICT r3 item 8) — and contributes no coverage (it ran on
+    truncated memory); siblings run; rerun is deterministic."""
     backend = create_backend("tpu", demo_tlv.build_snapshot(),
                              n_lanes=2, limit=100_000, overlay_slots=2)
     backend.initialize()
@@ -41,14 +43,51 @@ def test_overlay_overflow_guest_store_is_terminal_not_corrupting():
     # > 2 slots; lane 1: empty input touches input + stack only = 2 pages
     cases = [b"\x02\x08AAAAAAAA", b"\x01\x00"]
     results = backend.run_batch(cases, demo_tlv.TARGET)
-    assert isinstance(results[0], Crash) and "overlay" in results[0].name, \
-        results[0]
+    assert isinstance(results[0], OverlayFull), results[0]
     assert isinstance(results[1], Ok), results[1]
+    assert not backend.lane_found_new_coverage(0)
     r1 = [str(r) for r in results]
     demo_tlv.TARGET.restore()
     backend.restore()
     r2 = [str(r) for r in backend.run_batch(cases, demo_tlv.TARGET)]
     assert r1 == r2
+
+
+def test_overlay_full_requeues_in_fuzz_loop(tmp_path):
+    """The campaign driver gives an overlay-exhausted testcase ONE honest
+    re-run and never writes it under crashes/ (VERDICT r3 item 8 done
+    criterion)."""
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+
+    class ReplayMutator:
+        """Serves a fixed queue, then benign fillers."""
+
+        def __init__(self, queue):
+            self.queue = list(queue)
+
+        def get_new_testcase(self, corpus):
+            return self.queue.pop(0) if self.queue else b"\x01\x00"
+
+        def on_new_coverage(self, data):
+            pass
+
+    overflowing = b"\x02\x08AAAAAAAA"
+    backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                             n_lanes=2, limit=100_000, overlay_slots=2)
+    backend.initialize()
+    demo_tlv.TARGET.init(backend)
+    crashes = tmp_path / "crashes"
+    loop = FuzzLoop(backend, demo_tlv.TARGET, ReplayMutator([overflowing]),
+                    Corpus(), crashes_dir=crashes)
+    loop.run_one_batch()
+    assert loop.stats.overlay_fulls == 1
+    assert loop._requeue == [overflowing]        # queued for the re-run
+    loop.run_one_batch()                         # serves the requeue first
+    assert loop.stats.overlay_fulls == 2
+    assert loop._requeue == []                   # second exhaustion: dropped
+    assert loop.stats.crashes == 0
+    assert list(crashes.iterdir()) == []         # nothing saved as a crash
 
 
 def test_mixed_depth_batch():

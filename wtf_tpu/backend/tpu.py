@@ -31,7 +31,7 @@ import numpy as np
 
 from wtf_tpu.backend.base import Backend, BreakpointHandler
 from wtf_tpu.core.results import (
-    Cr3Change, Crash, Ok, TestcaseResult, Timedout,
+    Cr3Change, Crash, Ok, OverlayFull, TestcaseResult, Timedout,
 )
 from wtf_tpu.core.results import StatusCode
 from wtf_tpu.interp.runner import HostView, Runner
@@ -157,10 +157,13 @@ class TpuBackend(Backend):
         self._view = None
         statuses = runner.run(bp_handler=self._dispatch_bp)
 
-        # coverage merge on device (timeouts excluded; see module docstring)
+        # coverage merge on device (timeouts revoked like the reference
+        # client, and OVERLAY_FULL lanes excluded — they ran on truncated
+        # memory, their coverage is not trustworthy)
         m = runner.machine
         include = jnp.asarray(
             (statuses != int(StatusCode.TIMEDOUT))
+            & (statuses != int(StatusCode.OVERLAY_FULL))
             & (np.arange(self.n_lanes) < n_active))
         self._agg_cov, self._agg_edge, new_lane, new_words = _merge_coverage(
             self._agg_cov, self._agg_edge, m.cov, m.edge, include)
@@ -223,7 +226,7 @@ class TpuBackend(Backend):
             rip = int(np.asarray(self.runner.machine.rip)[lane])
             return Crash(f"crash-de-{rip:#x}")
         if status == StatusCode.OVERLAY_FULL:
-            return Crash("crash-overlay-full")
+            return OverlayFull()
         if status == StatusCode.HARD_ERROR:
             detail = self.runner.lane_errors.get(lane, "hard-error")
             return Crash(f"crash-{detail.split()[0]}")
@@ -245,6 +248,7 @@ class TpuBackend(Backend):
         m = runner.machine
         include = jnp.asarray(
             (statuses != int(StatusCode.TIMEDOUT))
+            & (statuses != int(StatusCode.OVERLAY_FULL))
             & (np.arange(self.n_lanes) == 0))
         self._agg_cov, self._agg_edge, new_lane, new_words = _merge_coverage(
             self._agg_cov, self._agg_edge, m.cov, m.edge, include)
@@ -387,4 +391,6 @@ def _result_status(result: TestcaseResult) -> StatusCode:
         return StatusCode.TIMEDOUT
     if isinstance(result, Cr3Change):
         return StatusCode.CR3_CHANGE
+    if isinstance(result, OverlayFull):
+        return StatusCode.OVERLAY_FULL
     return StatusCode.CRASH

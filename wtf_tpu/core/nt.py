@@ -74,6 +74,31 @@ def exception_code_to_str(code: int) -> str:
     return _EXCEPTION_NAMES.get(code, f"exception-{code:#x}")
 
 
+# -- ntdll pointer encoding --------------------------------------------------
+
+_M64 = (1 << 64) - 1
+
+
+def decode_pointer(cookie: int, value: int) -> int:
+    """ntdll's DecodePointer: ror64(value, 0x40 - (cookie & 0x3F)) ^ cookie
+    (reference utils.cc:302-304).  Harnesses need it to walk encoded
+    handler lists (PEB fast-fail handlers, KernelCallbackTable, etc.);
+    the cookie comes from the guest (e.g. ntdll!RtlpProcessCookie or a
+    NtQueryInformationProcess(ProcessCookie) result read at init)."""
+    rot = 0x40 - (cookie & 0x3F)
+    value &= _M64
+    rotated = ((value >> rot) | (value << (64 - rot))) & _M64
+    return rotated ^ cookie
+
+
+def encode_pointer(cookie: int, value: int) -> int:
+    """Inverse of decode_pointer (ntdll EncodePointer): xor first, then
+    rotate left by 0x40 - (cookie & 0x3F)."""
+    rot = 0x40 - (cookie & 0x3F)
+    mixed = (value ^ cookie) & _M64
+    return ((mixed << rot) | (mixed >> (64 - rot))) & _M64
+
+
 # -- EXCEPTION_RECORD64 ------------------------------------------------------
 
 @dataclasses.dataclass

@@ -77,7 +77,19 @@ class Crash:
         return f"crash({self.name or '?'})"
 
 
-TestcaseResult = Union[Ok, Timedout, Cr3Change, Crash]
+@dataclasses.dataclass(frozen=True)
+class OverlayFull:
+    """The lane ran out of dirty-page overlay slots — a resource limit of
+    THIS framework (no reference analog: its VMs have all of guest RAM).
+    Not a finding: excluded from crashes/ and from the coverage merge (the
+    run executed on truncated memory); campaign drivers requeue the
+    testcase so it still gets an honest execution."""
+
+    def __str__(self) -> str:
+        return "overlay-full"
+
+
+TestcaseResult = Union[Ok, Timedout, Cr3Change, Crash, OverlayFull]
 
 
 def is_crash(result: TestcaseResult) -> bool:

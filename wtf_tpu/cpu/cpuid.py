@@ -4,7 +4,7 @@ executor.
 The reference gets CPUID behavior from its virtualization layer (bochs' model
 or the host CPU via KVM/WHV, kvm_backend.cc:436-465 loads the host CPUID into
 the VM).  For determinism across backends and chips we pin one synthetic CPU
-identity: a generic x86-64 with SSE2/SSSE3/POPCNT and no AVX/XSAVE-dependent
+identity: a generic x86-64 with SSE2/POPCNT and no AVX/XSAVE-dependent
 features, so guests stay on code paths the interpreter supports.  Both
 executors consult this exact table, keeping differential traces aligned.
 """

@@ -34,7 +34,8 @@ from wtf_tpu.cpu.uops import (
     OPC_POP, OPC_RDGSBASE,
     OPC_MSR, OPC_POPF, OPC_PUSH, OPC_PUSHF, OPC_RDRAND, OPC_RDTSC, OPC_RET,
     OPC_SETCC, OPC_SHIFT, OPC_SSEALU, OPC_SSEMOV, OPC_STRING, OPC_SYSCALL,
-    OPC_UNARY, OPC_XADD, OPC_XCHG, OPC_XGETBV, REG_AH_BASE, REG_NONE,
+    OPC_UNARY, OPC_VZEROALL, OPC_XADD, OPC_XCHG, OPC_XGETBV,
+    REG_AH_BASE, REG_NONE,
     REG_RIP, REP_NONE, REP_REP, REP_REPNE, SEG_FS, SEG_GS, SEG_NONE,
     SH_SHL, SH_SHLD, SH_SHRD, SSE_PADDB, SSE_PAND, SSE_PANDN, SSE_PCMPEQB,
     SSE_PCMPEQD,
@@ -340,12 +341,14 @@ def _decode_vex(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
     pfx.rex = (w << 3) | (r << 2) | (x << 1) | b
     opsize = 8 if w else 4
 
-    if (mmmmm == 1 and opc == 0x77 and not l_bit
-            and pp == 0 and vvvv == 0):
-        # vzeroupper (pp/vvvv must be 0 — hardware #UDs otherwise): no
-        # YMM state in this machine model -> architectural no-op
-        # (compilers emit it at AVX/SSE transition points)
-        uop.opc = OPC_NOP
+    if mmmmm == 1 and opc == 0x77 and pp == 0 and vvvv == 0:
+        # pp/vvvv must be 0 — hardware #UDs otherwise.
+        # L=0: vzeroupper — no YMM state in this machine model, so an
+        #      architectural no-op (compilers emit it at AVX/SSE
+        #      transition points).
+        # L=1: vzeroall — zeroes the full registers, XMM state included:
+        #      a real operation here, serviced by the oracle.
+        uop.opc = OPC_VZEROALL if l_bit else OPC_NOP
         return
 
     if l_bit:  # VEX.256 (AVX) — not in the scalar subset

@@ -4,8 +4,8 @@ Role in the system (SURVEY.md §4): the reference's development workflow
 validates the fast backends against deterministic bochscpu `rip` traces; we
 keep the same methodology with this module as the trace producer.  It shares
 the decoder (cpu/decoder.py) with the device path, so a differential test
-pins down exactly one thing: that the JAX executor (cpu/exec.py) implements
-the same *semantics* for each uop.  It also powers the `emu` execution
+pins down exactly one thing: that the device executor (interp/step.py)
+implements the same *semantics* for each uop.  It also powers the `emu` execution
 backend (the "fake backend" seam, reference `Backend_t` §2.2) so the whole
 harness/fuzz/distribution plane is testable without a TPU.
 
@@ -719,6 +719,12 @@ class EmuCpu:
         elif opc == U.OPC_XGETBV:
             self.write_reg(0, 4, 0x7)  # x87+SSE+AVX state enabled
             self.write_reg(2, 4, 0)
+        elif opc == U.OPC_VZEROALL:
+            # zeroes the full vector registers — XMM state included (the
+            # L=0 form, vzeroupper, is a decoder-level NOP instead: no
+            # YMM state exists in this machine model)
+            for i in range(16):
+                self.xmm[i] = [0, 0]
         elif opc == U.OPC_SYSCALL:
             if uop.sub == 0:
                 self.gpr[1] = next_rip                       # rcx

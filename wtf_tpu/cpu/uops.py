@@ -85,8 +85,11 @@ OPC_PCLMUL = 44    # reserved
 OPC_PEXT = 45      # bmi: sub-op BMI_*
 OPC_STACKSTR = 46  # push/pop of segment etc (rare; unsupported)
 OPC_MSR = 47       # rdmsr/wrmsr (sub: 0 read, 1 write); oracle-serviced
+OPC_VZEROALL = 48  # vzeroall: zeroes xmm0-15 (no YMM state in this
+                   # model); oracle-serviced — rare enough not to earn a
+                   # device path
 
-N_OPC = 48
+N_OPC = 49
 
 # RFLAGS bits writable by flag-image restores (sysret r11, iretq frame):
 # CF PF AF ZF SF TF IF DF OF IOPL NT AC VIF VIP ID.  RF (bit 16) and VM

@@ -19,13 +19,14 @@ Reference `Server_t` (src/wtf/server.h): a single-threaded select() reactor
 
 from __future__ import annotations
 
+import hashlib
 import select
 import socket
 import time
 from pathlib import Path
 from typing import Dict, List, Optional, Set
 
-from wtf_tpu.core.results import Cr3Change, Crash, Timedout
+from wtf_tpu.core.results import Cr3Change, Crash, OverlayFull, Timedout
 from wtf_tpu.dist import wire
 from wtf_tpu.fuzz.corpus import Corpus
 from wtf_tpu.fuzz.mutator import Mutator
@@ -40,6 +41,7 @@ class ServerStats:
         self.crashes = 0
         self.timeouts = 0
         self.cr3s = 0
+        self.overlay_fulls = 0
         self.last_cov = time.time()
         self.start = time.time()
         self.last_print = 0.0
@@ -102,6 +104,7 @@ class Server:
         self.coverage: Set[int] = set()
         self.mutations = 0
         self.crash_names: Set[str] = set()
+        self._ovf_requeued: Set[str] = set()
         self._ever_served = False
         self._listener: Optional[socket.socket] = None
         # sock -> in-flight testcase bytes (None = idle, awaiting a feed)
@@ -168,6 +171,15 @@ class Server:
             self.stats.timeouts += 1
         elif isinstance(result, Cr3Change):
             self.stats.cr3s += 1
+        elif isinstance(result, OverlayFull):
+            # node resource limit, not a finding: requeue ONCE for an
+            # honest re-run (ideally on a node with more overlay slots);
+            # never saved under crashes/, never bounced forever
+            self.stats.overlay_fulls += 1
+            digest = hashlib.blake2b(testcase, digest_size=16).hexdigest()
+            if digest not in self._ovf_requeued:
+                self._ovf_requeued.add(digest)
+                self.paths.append(testcase)
 
     # -- reactor (server.h:361-598) ----------------------------------------
     def run(self, max_seconds: Optional[float] = None) -> ServerStats:

@@ -12,7 +12,9 @@ layout instead:
                      the testcase string)
   client -> server:  u32 testcase_len | testcase
                      u32 n_cov | n_cov * u64 coverage addresses
-                     u8 result kind (0 ok, 1 timedout, 2 cr3change, 3 crash)
+                     u8 result kind (0 ok, 1 timedout, 2 cr3change, 3 crash,
+                                     4 overlay-full: node resource limit —
+                                     master requeues the testcase)
                      u16 name_len | crash name utf-8
                      (client.cc:187-200 / server.h:771-779 message shape)
 """
@@ -24,7 +26,7 @@ import struct
 from typing import Optional, Set, Tuple
 
 from wtf_tpu.core.results import (
-    Cr3Change, Crash, Ok, TestcaseResult, Timedout,
+    Cr3Change, Crash, Ok, OverlayFull, TestcaseResult, Timedout,
 )
 
 MAX_MSG = 64 * 1024 * 1024  # sanity bound on a frame
@@ -120,7 +122,7 @@ def recv_msg(sock: socket.socket) -> Optional[bytes]:
 # result message body
 # ---------------------------------------------------------------------------
 
-_KIND = {Ok: 0, Timedout: 1, Cr3Change: 2, Crash: 3}
+_KIND = {Ok: 0, Timedout: 1, Cr3Change: 2, Crash: 3, OverlayFull: 4}
 
 
 def encode_result(testcase: bytes, coverage: Set[int],
@@ -163,6 +165,8 @@ def decode_result(body: bytes) -> Tuple[bytes, Set[int], TestcaseResult]:
         result = Timedout()
     elif kind == 2:
         result = Cr3Change()
+    elif kind == 4:
+        result = OverlayFull()
     else:
         result = Crash(name or None)
     return testcase, coverage, result

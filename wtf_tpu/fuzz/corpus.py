@@ -63,8 +63,11 @@ class Corpus:
         """Seed from a directory of input files, biggest first (the
         reference master replays inputs/ sorted by size, server.h:399-414)."""
         corpus = Corpus(outputs_dir=outputs_dir, rng=rng)
-        for f, _ in seed_paths([path]):
-            corpus.add(f.read_bytes())
+        # with_data: each file is read exactly once (seed_paths already
+        # read+digested it; a second read_bytes would double startup I/O
+        # and open a TOCTOU window between digest and content)
+        for _f, digest, data in seed_paths([path], with_data=True):
+            corpus.add_digested(data, digest)
         return corpus
 
 

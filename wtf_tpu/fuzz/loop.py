@@ -21,7 +21,7 @@ import time
 from pathlib import Path
 from typing import Optional
 
-from wtf_tpu.core.results import Crash, Cr3Change, Ok, Timedout
+from wtf_tpu.core.results import Crash, Cr3Change, Ok, OverlayFull, Timedout
 from wtf_tpu.fuzz.corpus import Corpus
 from wtf_tpu.fuzz.mutator import Mutator
 from wtf_tpu.utils.hashing import hex_digest
@@ -37,6 +37,7 @@ class CampaignStats:
         self.crashes = 0
         self.timeouts = 0
         self.cr3s = 0
+        self.overlay_fulls = 0
         self.new_coverage = 0
         self.start = time.time()
         self.last_print = 0.0
@@ -47,10 +48,11 @@ class CampaignStats:
 
     def line(self, corpus_len: int, cov: int) -> str:
         uptime = seconds_to_human(time.time() - self.start)
+        ovf = f" ovf: {self.overlay_fulls}" if self.overlay_fulls else ""
         return (f"#{self.testcases} cov: {cov} corp: {corpus_len} "
                 f"exec/s: {self.execs_per_sec():.1f} "
                 f"crash: {self.crashes} timeout: {self.timeouts} "
-                f"cr3: {self.cr3s} uptime: {uptime}")
+                f"cr3: {self.cr3s}{ovf} uptime: {uptime}")
 
 
 class FuzzLoop:
@@ -75,16 +77,24 @@ class FuzzLoop:
         self.stats = CampaignStats()
         self.stats_every = stats_every
         self.crash_names = set()
+        # overlay-exhausted testcases get ONE honest re-run (they executed
+        # on truncated memory); a second exhaustion drops them — the input
+        # genuinely needs more dirty pages than the lane has slots
+        self._requeue: list = []
+        self._requeue_digests = set()
 
     def run_one_batch(self) -> int:
         """Returns the number of crashes found in this batch."""
+        requeued, self._requeue = self._requeue[:self.batch_size], []
+        fresh = self.batch_size - len(requeued)
         if hasattr(self.mutator, "get_new_batch"):
             # native engines mutate the whole batch in one C call
-            testcases = self.mutator.get_new_batch(
-                self.corpus, self.batch_size)
+            testcases = requeued + (self.mutator.get_new_batch(
+                self.corpus, fresh) if fresh else [])
         else:
-            testcases = [self.mutator.get_new_testcase(self.corpus)
-                         for _ in range(self.batch_size)]
+            testcases = requeued + [
+                self.mutator.get_new_testcase(self.corpus)
+                for _ in range(fresh)]
         results = self.backend.run_batch(testcases, self.target)
         crashes = 0
         for lane, (data, result) in enumerate(zip(testcases, results)):
@@ -93,6 +103,12 @@ class FuzzLoop:
                 self.stats.timeouts += 1
             elif isinstance(result, Cr3Change):
                 self.stats.cr3s += 1
+            elif isinstance(result, OverlayFull):
+                self.stats.overlay_fulls += 1
+                digest = hex_digest(data)
+                if digest not in self._requeue_digests:
+                    self._requeue_digests.add(digest)
+                    self._requeue.append(data)
             elif isinstance(result, Crash):
                 self.stats.crashes += 1
                 crashes += 1
@@ -131,6 +147,8 @@ class FuzzLoop:
                     self.stats.timeouts += 1
                 elif isinstance(result, Cr3Change):
                     self.stats.cr3s += 1
+                elif isinstance(result, OverlayFull):
+                    self.stats.overlay_fulls += 1
                 elif isinstance(result, Crash):
                     self.stats.crashes += 1
                     self._save_crash(data, result)

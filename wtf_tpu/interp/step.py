@@ -401,7 +401,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     unsupported = pre_live & (
         is_(U.OPC_INVALID) | is_(U.OPC_IRET) | is_(U.OPC_MSR)
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
-        | is_(U.OPC_STACKSTR) | (is_(U.OPC_RDGSBASE) & (sub != 4))
+        | is_(U.OPC_STACKSTR) | is_(U.OPC_VZEROALL)
+        | (is_(U.OPC_RDGSBASE) & (sub != 4))
         | movcr_bad | div64_hard)
 
     # -- 4a. effective address -------------------------------------------
