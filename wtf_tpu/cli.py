@@ -85,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
     fuzz.add_argument("--address", default=DEFAULT_ADDRESS)
     fuzz.add_argument("--seed", type=int, default=0)
     fuzz.add_argument("--lanes", type=int, default=64)
+    fuzz.add_argument("--mux", action="store_true",
+                      help="one multiplexed master connection for the whole"
+                           " lane batch instead of one per lane (scales a"
+                           " wide node past the master's fd budget)")
 
     master = sub.add_parser("master", help="master node (serves testcases)")
     _add_target_selection(master)
@@ -220,8 +224,10 @@ def cmd_fuzz(args) -> int:
     target = _lookup_target(args)
     backend = _build_backend(target, opts.backend, opts.paths,
                              opts.limit, opts.lanes)
-    node_cls = BatchClient if opts.backend == "tpu" else Client
-    node = node_cls(backend, target, opts.address)
+    if opts.backend == "tpu":
+        node = BatchClient(backend, target, opts.address, mux=args.mux)
+    else:
+        node = Client(backend, target, opts.address)
     served = node.run()
     print(f"node served {served} testcases")
     return 0

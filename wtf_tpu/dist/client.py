@@ -54,6 +54,7 @@ class Client:
         """Serve until the master closes (or max_runs served)."""
         self.target.init(self.backend)
         sock = wire.dial(self.address, retry_for=10.0)
+        wire.send_msg(sock, wire.encode_hello(1))
         try:
             while max_runs == 0 or self.runs < max_runs:
                 try:
@@ -76,20 +77,36 @@ class Client:
 
 
 class BatchClient:
-    """TPU node: n_lanes master connections, one device batch per round."""
+    """TPU node: one device batch per round against the master.
 
-    def __init__(self, backend, target, address: str):
+    Two wire shapes (selected by `mux`):
+      mux=False  n_lanes connections, one hello(1) each — byte-compatible
+                 with the reference's process-per-core nodes; the master
+                 cannot tell a TPU pod from n_lanes ordinary clients.
+      mux=True   ONE connection with hello(n_lanes): the master sends a
+                 batch frame of up to n_lanes testcases per round and gets
+                 one batch frame of results back.  This is what scales a
+                 4096-lane node: 1 fd instead of 4096.
+    """
+
+    def __init__(self, backend, target, address: str, mux: bool = False):
         self.backend = backend
         self.target = target
         self.address = address
+        self.mux = mux
         self.rounds = 0
         self.runs = 0
 
     def run(self, max_rounds: int = 0) -> int:
+        if self.mux:
+            return self._run_mux(max_rounds)
         self.target.init(self.backend)
         n = self.backend.n_lanes
-        socks: List[socket.socket] = [
-            wire.dial(self.address, retry_for=10.0) for _ in range(n)]
+        socks: List[socket.socket] = []
+        for _ in range(n):
+            sock = wire.dial(self.address, retry_for=10.0)
+            wire.send_msg(sock, wire.encode_hello(1))
+            socks.append(sock)
         try:
             while max_rounds == 0 or self.rounds < max_rounds:
                 batch: List[bytes] = []
@@ -131,4 +148,42 @@ class BatchClient:
         finally:
             for sock in socks:
                 sock.close()
+        return self.runs
+
+    def _run_mux(self, max_rounds: int = 0) -> int:
+        """Multiplexed rounds: one batch frame in, one batch frame out."""
+        self.target.init(self.backend)
+        sock = wire.dial(self.address, retry_for=10.0)
+        wire.send_msg(sock, wire.encode_hello(self.backend.n_lanes))
+        try:
+            while max_rounds == 0 or self.rounds < max_rounds:
+                try:
+                    frame = wire.recv_msg(sock)
+                except (OSError, ValueError):
+                    break  # reset or desynced frame: master gone
+                if frame is None:
+                    break
+                batch = wire.decode_batch(frame)
+                if not batch:
+                    break
+                results = self.backend.run_batch(batch, self.target)
+                replies = []
+                for lane, (data, result) in enumerate(zip(batch, results)):
+                    coverage = self.backend.lane_coverage(lane)
+                    if isinstance(result, Timedout):
+                        coverage = set()  # revoked (client.cc:122-125)
+                    elif not self.backend.lane_found_new_coverage(lane):
+                        coverage = set()  # nothing new to report
+                    replies.append(
+                        wire.encode_result(data, coverage, result))
+                    self.runs += 1
+                try:
+                    wire.send_msg(sock, wire.encode_batch(replies))
+                except OSError:
+                    break  # master hung up mid-report
+                self.target.restore()
+                self.backend.restore()
+                self.rounds += 1
+        finally:
+            sock.close()
         return self.runs

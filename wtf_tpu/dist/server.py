@@ -20,7 +20,7 @@ Reference `Server_t` (src/wtf/server.h): a single-threaded select() reactor
 from __future__ import annotations
 
 import hashlib
-import select
+import selectors
 import socket
 import time
 from pathlib import Path
@@ -55,6 +55,19 @@ class ServerStats:
                 f"{seconds_to_human(time.time() - self.last_cov)} "
                 f"crash: {self.crashes} timeout: {self.timeouts} "
                 f"cr3: {self.cr3s} uptime: {seconds_to_human(dt)}")
+
+
+class _Conn:
+    """Per-connection master state: slot count from the node's hello frame
+    (1 = reference shape; >1 = lane-multiplexed batch frames) and the
+    testcases in flight on it."""
+
+    __slots__ = ("slots", "mux", "inflight")
+
+    def __init__(self):
+        self.slots = 1
+        self.mux = False
+        self.inflight: List[bytes] = []
 
 
 class Server:
@@ -107,8 +120,8 @@ class Server:
         self._ovf_requeued: Set[str] = set()
         self._ever_served = False
         self._listener: Optional[socket.socket] = None
-        # sock -> in-flight testcase bytes (None = idle, awaiting a feed)
-        self._clients: Dict[socket.socket, Optional[bytes]] = {}
+        self._clients: Dict[socket.socket, _Conn] = {}
+        self._sel: Optional[selectors.BaseSelector] = None
 
     # -- testcase generation (server.h:629-714) ----------------------------
     def _next_seed(self) -> Optional[bytes]:
@@ -134,7 +147,7 @@ class Server:
         return self.mutator.get_new_testcase(self.corpus)[:self.max_len]
 
     def done(self) -> bool:
-        outstanding = any(v is not None for v in self._clients.values())
+        outstanding = any(conn.inflight for conn in self._clients.values())
         if outstanding:
             return False
         gen_done = self.mutations >= self.runs if self.runs else True
@@ -183,7 +196,14 @@ class Server:
 
     # -- reactor (server.h:361-598) ----------------------------------------
     def run(self, max_seconds: Optional[float] = None) -> ServerStats:
+        """Event loop on `selectors` (epoll on Linux) — unlike the
+        reference's select() reactor (server.h:386-389) there is no
+        FD_SETSIZE ceiling, so thousands of 1-fd-per-lane nodes work; a
+        multiplexed node (wire.encode_hello(n) with n > 1) needs only ONE
+        fd for a whole lane batch on top of that."""
         self._listener = wire.listen(self.address)
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._listener, selectors.EVENT_READ)
         deadline = time.time() + max_seconds if max_seconds else None
         try:
             while True:
@@ -191,19 +211,21 @@ class Server:
                     break
                 if deadline and time.time() > deadline:
                     break
-                rlist = [self._listener] + list(self._clients)
-                # lock-step: only clients we haven't fed yet are writable
-                wlist = [c for c, inflight in self._clients.items()
-                         if inflight is None]
-                ready_r, ready_w, _ = select.select(rlist, wlist, [], 0.5)
-                for sock in ready_w:
-                    self._feed(sock)
-                for sock in ready_r:
+                for key, events in self._sel.select(timeout=0.5):
+                    sock = key.fileobj
                     if sock is self._listener:
                         conn, _ = self._listener.accept()
-                        self._clients[conn] = None
+                        self._clients[conn] = _Conn()
+                        # not writable until the hello names its slot count
+                        self._sel.register(conn, selectors.EVENT_READ)
                         continue
-                    self._on_readable(sock)
+                    if sock not in self._clients:
+                        continue  # dropped earlier in this pass
+                    if events & selectors.EVENT_WRITE:
+                        self._feed(sock)
+                    if (events & selectors.EVENT_READ
+                            and sock in self._clients):
+                        self._on_readable(sock)
                 now = time.time()
                 if (self._dirwatch is not None
                         and now - self._dirwatch_last >= 1.0):
@@ -224,6 +246,8 @@ class Server:
             for sock in list(self._clients):
                 sock.close()
             self._clients.clear()
+            self._sel.close()
+            self._sel = None
             self._listener.close()
             self._listener = None
             self._write_coverage()
@@ -248,28 +272,43 @@ class Server:
         except OSError as e:
             print(f"coverage.cov write failed: {e}")
 
+    def _set_writable(self, sock: socket.socket, want: bool) -> None:
+        events = selectors.EVENT_READ | (selectors.EVENT_WRITE if want else 0)
+        self._sel.modify(sock, events)
+
     def _feed(self, sock: socket.socket) -> None:
-        testcase = self.get_testcase()
-        if testcase is None:
-            # no work left (budget exhausted / seeds drained): close the
-            # idle client now — a batch node collecting one testcase per
-            # lane would otherwise block on this socket while the master
-            # waits for the node's other lanes' results (tail deadlock)
+        conn = self._clients[sock]
+        batch: List[bytes] = []
+        while len(batch) < conn.slots:
+            testcase = self.get_testcase()
+            if testcase is None:
+                break
+            batch.append(testcase)
+        if not batch:
+            # no work at all (budget exhausted / seeds drained): close the
+            # idle client now — a batch node would otherwise block on this
+            # socket while the master waits for its siblings (tail deadlock)
             self._drop(sock)
             return
         try:
-            wire.send_msg(sock, testcase)
-            self._clients[sock] = testcase  # in-flight until its result
+            if conn.mux:
+                wire.send_msg(sock, wire.encode_batch(batch))
+            else:
+                wire.send_msg(sock, batch[0])
+            conn.inflight = batch  # in-flight until their results return
             self._ever_served = True
+            self._set_writable(sock, False)
         except OSError:
             # undelivered: requeue (budget stays consumed — the requeued
-            # entry re-serves from paths without a new mutation, so the
+            # entries re-serve from paths without a new mutation, so the
             # campaign executes exactly `runs` testcases as long as any
             # client remains connected; elasticity, server.h:534-544)
+            self._clients[sock].inflight = []
             self._drop(sock)
-            self.paths[:0] = [testcase]
+            self.paths[:0] = batch
 
     def _on_readable(self, sock: socket.socket) -> None:
+        conn = self._clients[sock]
         try:
             body = wire.recv_msg(sock)
         except (OSError, ValueError):
@@ -277,14 +316,30 @@ class Server:
         if body is None:
             self._drop(sock)
             return
-        self.handle_result(body)
-        self._clients[sock] = None
+        n_slots = wire.decode_hello(body)
+        if n_slots is not None:
+            conn.slots = max(1, n_slots)
+            conn.mux = conn.slots > 1
+            if not conn.inflight:
+                self._set_writable(sock, True)  # greeted: open for work
+            return
+        if conn.mux:
+            for result_body in wire.decode_batch(body):
+                self.handle_result(result_body)
+        else:
+            self.handle_result(body)
+        conn.inflight = []
+        self._set_writable(sock, True)
 
     def _drop(self, sock: socket.socket) -> None:
-        # a dying client's in-flight testcase is re-served to someone else
-        inflight = self._clients.pop(sock, None)
-        if inflight is not None:
-            self.paths[:0] = [inflight]
+        # a dying client's in-flight testcases are re-served to others
+        conn = self._clients.pop(sock, None)
+        if conn is not None and conn.inflight:
+            self.paths[:0] = conn.inflight
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError):
+            pass
         sock.close()
 
     def _maybe_print(self) -> None:

@@ -119,6 +119,53 @@ def recv_msg(sock: socket.socket) -> Optional[bytes]:
 
 
 # ---------------------------------------------------------------------------
+# hello + batch frames (lane multiplexing)
+# ---------------------------------------------------------------------------
+# Every node opens with a hello frame claiming n_slots.  n_slots == 1 is
+# the reference shape (one testcase frame in flight, one result frame
+# back).  n_slots > 1 multiplexes a whole lane batch over ONE connection:
+# the master sends a batch frame of up to n_slots testcases and the node
+# replies with one batch frame of results — what lets a 4096-lane TPU node
+# talk to the master through a single fd instead of 4096 (the reference
+# is architecturally 1 fd per core, server.h:386-389, and its select()
+# master caps out at FD_SETSIZE).
+
+HELLO_MAGIC = b"WTFH"
+
+
+def encode_hello(n_slots: int) -> bytes:
+    return HELLO_MAGIC + struct.pack("<I", n_slots)
+
+
+def decode_hello(body: bytes) -> Optional[int]:
+    """n_slots when `body` is a hello frame, else None."""
+    if len(body) == 8 and body[:4] == HELLO_MAGIC:
+        return struct.unpack_from("<I", body, 4)[0]
+    return None
+
+
+def encode_batch(items) -> bytes:
+    """Concatenate length-prefixed blobs into one batch frame body."""
+    parts = [struct.pack("<I", len(items))]
+    for item in items:
+        parts.append(struct.pack("<I", len(item)))
+        parts.append(item)
+    return b"".join(parts)
+
+
+def decode_batch(body: bytes) -> list:
+    (n,) = struct.unpack_from("<I", body, 0)
+    off = 4
+    items = []
+    for _ in range(n):
+        (length,) = struct.unpack_from("<I", body, off)
+        off += 4
+        items.append(body[off:off + length])
+        off += length
+    return items
+
+
+# ---------------------------------------------------------------------------
 # result message body
 # ---------------------------------------------------------------------------
 
