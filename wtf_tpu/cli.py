@@ -122,6 +122,11 @@ def build_parser() -> argparse.ArgumentParser:
     camp.add_argument("--seed", type=int, default=0)
     camp.add_argument("--lanes", type=int, default=64)
     camp.add_argument("--stop-on-crash", action="store_true")
+    camp.add_argument("--coordinator", default=None,
+                      help="jax.distributed coordinator address for a"
+                           " multi-host launch (host:port)")
+    camp.add_argument("--num-processes", type=int, default=None)
+    camp.add_argument("--process-id", type=int, default=None)
     return parser
 
 
@@ -265,6 +270,18 @@ def cmd_campaign(args) -> int:
                            lanes=args.lanes,
                            stop_on_crash=args.stop_on_crash,
                            paths=_paths_from(args))
+    if args.coordinator or args.num_processes:
+        # multi-host launch: join the jax distributed runtime first (DCN
+        # coordination; tests/test_parallel.py exercises the same path on
+        # 2 CPU processes).  Each host then drives its local chips; the
+        # global mesh is available to sharded execution paths
+        # (parallel/mesh.py), and cross-host work distribution rides the
+        # TCP master plane exactly like separate pods.
+        from wtf_tpu.parallel.mesh import init_multihost
+
+        init_multihost(coordinator=args.coordinator,
+                       num_processes=args.num_processes,
+                       process_id=args.process_id)
     target = _lookup_target(args)
     backend = _build_backend(target, opts.backend, opts.paths,
                              opts.limit, opts.lanes)

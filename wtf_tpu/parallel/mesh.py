@@ -58,25 +58,40 @@ def init_multihost(coordinator: Optional[str] = None,
     return make_mesh()
 
 
+def _is_multiprocess(mesh: Mesh) -> bool:
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def _place(leaf, sharding, mesh: Mesh):
+    """device_put within one process; across processes every host holds
+    the same global value (machines broadcast from one snapshot, images
+    and uop tables are replicated by construction), so each process
+    donates its addressable shards of that value via the callback form."""
+    if not _is_multiprocess(mesh):
+        return jax.device_put(leaf, sharding)
+    arr = np.asarray(leaf)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
 def shard_machine(machine: Machine, mesh: Mesh) -> Machine:
     """Place every per-lane leaf with its leading axis split over the mesh.
 
     n_lanes must divide by mesh size.  Returns the same pytree with
     device-sharded arrays; everything downstream (run_chunk, coverage
     merge) is shape-identical, so jit compiles SPMD executables with XLA
-    inserting the cross-chip collectives."""
+    inserting the cross-chip collectives.  On a multi-host mesh every
+    process must call this with the SAME host value (true for machines
+    built from one snapshot) and the array becomes global."""
     sharding = NamedSharding(mesh, P(LANE_AXIS))
-
-    def place(leaf):
-        return jax.device_put(leaf, sharding)
-
-    return jax.tree.map(place, machine)
+    return jax.tree.map(lambda leaf: _place(leaf, sharding, mesh), machine)
 
 
 def replicate(tree, mesh: Mesh):
     """Replicate snapshot image / uop table on every mesh device."""
     sharding = NamedSharding(mesh, P())
-    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), tree)
+    return jax.tree.map(lambda leaf: _place(leaf, sharding, mesh), tree)
 
 
 def _or_reduce_lanes(words, groups: Optional[int]):
