@@ -124,3 +124,108 @@ def test_dmp_snapshot_fuzzes(tmp_path):
         [b"\x01\x02AB", bytes([3, 64]) + b"A" * 64], demo_tlv.TARGET)
     assert isinstance(results[0], Ok)
     assert isinstance(results[1], Crash)
+
+
+# ---------------------------------------------------------------------------
+# differential vs the REFERENCE kdmp-parser (VERDICT r3 item 4)
+# ---------------------------------------------------------------------------
+
+_REF_LIB = "/root/reference/src/libs/kdmp-parser/src/lib"
+
+
+@pytest.fixture(scope="session")
+def ref_testapp(tmp_path_factory):
+    """Compile the reference header-only parser into a check binary (our
+    tests/native/kdmp_ref_check.cc); skip where the reference tree or a
+    C++ toolchain isn't available."""
+    import shutil
+    import subprocess
+    from pathlib import Path as _P
+
+    if not _P(_REF_LIB).is_dir():
+        pytest.skip("reference kdmp-parser sources not available")
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    src = _P(__file__).parent / "native" / "kdmp_ref_check.cc"
+    out = tmp_path_factory.mktemp("kdmpref") / "kdmp_ref_check"
+    proc = subprocess.run(
+        ["g++", "-O1", "-std=c++20", f"-I{_REF_LIB}", str(src),
+         "-o", str(out)], capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.skip(f"reference parser does not build: {proc.stderr[-300:]}")
+    return out
+
+
+def _fnv1a_pages(pages):
+    h = 0xCBF29CE484222325
+    for pfn in sorted(pages):
+        pa = pfn << 12
+        for chunk in (pa.to_bytes(8, "little"), pages[pfn]):
+            for b in chunk:
+                h = ((h ^ b) * 0x100000001B3) & (1 << 64) - 1
+    return h
+
+
+@pytest.mark.parametrize("dump_type", ["full", "bmp"])
+def test_differential_vs_reference_parser(tmp_path, dump_type, ref_testapp):
+    """Break the closed writer->parser loop: the same dump must yield the
+    same DTB / context / page set / page CONTENTS from the reference's
+    battle-tested parser and from ours (native + pure-Python).  A shared
+    misreading of the format between our writer and our parser would
+    round-trip cleanly but diverge here."""
+    import json
+    import subprocess
+
+    path = tmp_path / "mem.dmp"
+    cpu = CpuState()
+    cpu.rip = 0xFFFFF805_1087_76A0
+    cpu.rsp = 0xFFFFF805_1356_84F8
+    cpu.rax = 3
+    cpu.rcx = 1
+    cpu.r15 = 0x52
+    cpu.rflags = 0x40202
+    cpu.cs.selector = 0x10
+    cpu.ss.selector = 0x18
+    pages = _pages()
+    kdmp.write_kdmp(path, pages, dump_type=dump_type, dtb=0x6D4000, cpu=cpu)
+
+    proc = subprocess.run([str(ref_testapp), str(path)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    ref = json.loads(proc.stdout)
+
+    # reference enum: FullDump=1, BMPDump=5 (kdmp-parser-structs.h)
+    assert ref["type"] == {"full": 1, "bmp": 5}[dump_type]
+    assert ref["dtb"] == 0x6D4000
+    assert ref["n_pages"] == len(pages)
+    assert ref["rip"] == cpu.rip
+    assert ref["rsp"] == cpu.rsp
+    assert ref["rax"] == cpu.rax
+    assert ref["rcx"] == cpu.rcx
+    assert ref["r15"] == cpu.r15
+    assert ref["eflags"] == cpu.rflags
+    assert ref["seg_cs"] == 0x10 and ref["seg_ss"] == 0x18
+    assert ref["first_pa"] == min(pages) << 12
+    assert ref["last_pa"] == max(pages) << 12
+
+    # now OUR parsers (both paths) must agree with the reference, page
+    # contents included (same fnv1a(pa || bytes) digest formula)
+    from unittest import mock
+
+    for parser in ("native", "python"):
+        if parser == "native" and kdmp._native_lib() is None:
+            continue
+        patch = (mock.patch.object(kdmp, "_parse_native", lambda p: None)
+                 if parser == "python" else mock.patch.object(
+                     kdmp, "_IGNORED_", None, create=True))
+        with patch:
+            info = kdmp.parse_kdmp_info(path)
+            got_pages = kdmp.parse_kdmp(path)
+        regs = info.context_registers()
+        assert info.dtb == ref["dtb"], parser
+        assert info.n_pages == ref["n_pages"], parser
+        assert regs["rip"] == ref["rip"], parser
+        assert regs["rsp"] == ref["rsp"], parser
+        assert regs["rflags"] == ref["eflags"], parser
+        assert regs["cs"] == ref["seg_cs"], parser
+        assert _fnv1a_pages(got_pages) == ref["pages_digest"], parser

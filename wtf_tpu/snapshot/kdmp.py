@@ -348,6 +348,11 @@ def _write_context(header: bytearray, cpu) -> None:
         struct.pack_into("<Q", header, base + _CTX_RIP, cpu.rip)
         struct.pack_into("<I", header, base + _CTX_EFLAGS,
                          cpu.rflags & 0xFFFFFFFF)
+        # segment selectors (CONTEXT order: cs ds es fs gs ss) — found
+        # missing by the reference-parser differential (test_kdmp.py)
+        for i, seg in enumerate(("cs", "ds", "es", "fs", "gs", "ss")):
+            struct.pack_into("<H", header, base + _CTX_SEGCS + i * 2,
+                             getattr(cpu, seg).selector & 0xFFFF)
         mxcsr = getattr(cpu, "mxcsr", mxcsr)
         for i in range(16):
             struct.pack_into("<QQ", header, base + _CTX_XMM0 + i * 16,
