@@ -91,6 +91,10 @@ HW_CASES = [
     ("xchg8h", "xchg ah, dl", FLAGS_MASK),
     ("lea", "lea rax, [rbx + rcx*4 + 0x30]", FLAGS_MASK),
     ("lea32", "lea eax, [rbx + rdi*2 - 5]", FLAGS_MASK),
+    # 67h address-size override: EA truncated to 32 bits (lea exposes the
+    # masked EA without a memory access — hardware-differential safe)
+    ("lea_a32", "lea rax, [ebx + ecx*4 + 0x30]", FLAGS_MASK),
+    ("lea_a32_neg", "lea rax, [edi - 5]", FLAGS_MASK),
     ("setcc", "cmp rax, rbx\nsete cl\nsetl dl\nsetb r8b", FLAGS_MASK),
     ("cmov_taken", "cmp rax, rax\ncmove rbx, rcx", FLAGS_MASK),
     ("cmov_nottaken", "cmp rax, rax\ncmovne rbx, rcx", FLAGS_MASK),
@@ -606,3 +610,23 @@ def test_vzeroall_zeroes_xmm_state():
     assert cpu.gpr[3] == 0x1122334455667788  # vzeroupper kept xmm3
     assert cpu.gpr[1] == 0                   # vzeroall cleared xmm9
     assert all(cpu.xmm[i] == [0, 0] for i in range(16))
+
+
+def test_a32_memory_access_and_riprel():
+    """67h memory forms: the EA truncates to 32 bits before translation —
+    a base register with garbage upper bits still hits the low mapping;
+    eip-relative truncates the same way (oracle-level: rip is guest-chosen
+    so a hardware differential can't pin it)."""
+    low = 0x2000_0000  # must fit in 32 bits for the 67h-masked access
+    cpu = run_emu(
+        f"""
+        mov rbx, {0xDEAD_0000_0000 + low}   # garbage upper bits
+        mov rax, [ebx]                      # 67h: EA masks back to `low`
+        lea rcx, [eip]
+        hlt
+        """,
+        data={low: (0x1122334455667788).to_bytes(8, "little")})
+    assert cpu.gpr[0] == 0x1122334455667788
+    # lea rcx,[eip]: rip after the lea (10-byte movabs + 4-byte 67h load
+    # + 8-byte 67h rip-relative lea), truncated to 32 bits
+    assert cpu.gpr[1] == (CODE_BASE + 22) & 0xFFFFFFFF

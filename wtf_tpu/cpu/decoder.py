@@ -34,7 +34,11 @@ from wtf_tpu.cpu.uops import (
     OPC_POP, OPC_RDGSBASE,
     OPC_MSR, OPC_POPF, OPC_PUSH, OPC_PUSHF, OPC_RDRAND, OPC_RDTSC, OPC_RET,
     OPC_SETCC, OPC_SHIFT, OPC_SSEALU, OPC_SSEMOV, OPC_STRING, OPC_SYSCALL,
-    OPC_UNARY, OPC_VZEROALL, OPC_XADD, OPC_XCHG, OPC_XGETBV,
+    OPC_SSEFP, OPC_UNARY, OPC_VZEROALL, OPC_XADD, OPC_XCHG, OPC_XGETBV,
+    FP_ADD, FP_SUB, FP_MUL, FP_DIV, FP_MIN, FP_MAX, FP_SQRT, FP_UCOMI,
+    FP_COMI, FP_CMP, FP_CVT_I2F, FP_CVT_F2I, FP_CVT_F2I_T, FP_CVT_F2F,
+    FP_CVT_DQ2PS, FP_CVT_PS2DQ, FP_CVT_PS2DQ_T, FP_SHUF, FP_UNPCKL,
+    FP_UNPCKH, FP_CVT_DQ2PD, FP_CVT_PD2DQ, FP_CVT_PD2DQ_T,
     REG_AH_BASE, REG_NONE,
     REG_RIP, REP_NONE, REP_REP, REP_REPNE, SEG_FS, SEG_GS, SEG_NONE,
     SH_SHL, SH_SHLD, SH_SHRD, SSE_PADDB, SSE_PAND, SSE_PANDN, SSE_PCMPEQB,
@@ -289,10 +293,9 @@ def _decode_prefixes(cur: _Cursor) -> _Prefixes:
 def _decode_inner(code: bytes) -> Uop:
     cur = _Cursor(code[:MAX_INSN_LEN])
     pfx = _decode_prefixes(cur)
-    if pfx.asize:
-        return Uop(opc=OPC_INVALID, length=cur.pos + 1)
     op = cur.u8()
     uop = Uop()
+    uop.a32 = int(pfx.asize)  # 67h: EA truncated to 32 bits (both engines)
     uop.lock = int(pfx.lock)
 
     if op in (0xC4, 0xC5) and not pfx.any_legacy and not pfx.rex_present:
@@ -1137,11 +1140,154 @@ def _decode_0f_sse(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         xmm_reg(modrm, is_dst=False)
         return
 
+    # ---- SSE/SSE2 floating point (OPC_SSEFP; oracle-serviced) ----------
+    # The dominant decode gap measured on real Windows-PE codegen (VERDICT
+    # r3 item 3; tools/decode_census.py).  Element width + packedness from
+    # the prefix: F2 = sd, F3 = ss, 66 = pd, none = ps — stored in
+    # srcsize (4/8) and sext (1 = packed).
+    def fp_elem():
+        if pfx.repne:
+            return 8, 0   # scalar double
+        if pfx.rep:
+            return 4, 0   # scalar single
+        if pfx.osize:
+            return 8, 1   # packed double
+        return 4, 1       # packed single
+
+    _FP_ARITH = {0x51: FP_SQRT, 0x58: FP_ADD, 0x59: FP_MUL, 0x5C: FP_SUB,
+                 0x5D: FP_MIN, 0x5E: FP_DIV, 0x5F: FP_MAX}
+    if op in _FP_ARITH:
+        uop.opc, uop.sub = OPC_SSEFP, _FP_ARITH[op]
+        uop.srcsize, uop.sext = fp_elem()
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        return
+
+    if op in (0x2E, 0x2F):  # ucomiss/sd, comiss/sd: rflags only
+        if pfx.rep or pfx.repne:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc = OPC_SSEFP
+        uop.sub = FP_UCOMI if op == 0x2E else FP_COMI
+        uop.srcsize, uop.sext = (8 if pfx.osize else 4), 0
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)  # compared reg; no writeback
+        xmm_rm(modrm, is_dst=False)
+        return
+
+    if op == 0xC2:  # cmpps/ss/pd/sd imm8 predicate -> mask
+        uop.opc, uop.sub = OPC_SSEFP, FP_CMP
+        uop.srcsize, uop.sext = fp_elem()
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        uop.imm = cur.u8()
+        return
+
+    if op == 0x2A:  # cvtsi2ss/sd (gpr/mem int -> fp scalar)
+        if not (pfx.rep or pfx.repne):
+            uop.opc = OPC_INVALID  # MMX cvtpi2ps out of scope
+            return
+        uop.opc, uop.sub = OPC_SSEFP, FP_CVT_I2F
+        uop.srcsize, uop.sext = (8 if pfx.repne else 4), 0
+        uop.opsize = 8 if pfx.rex_w else 4  # integer operand width
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        _rm_operand(uop, modrm, pfx, is_dst=False)
+        return
+
+    if op in (0x2C, 0x2D):  # cvtt/cvt ss/sd -> gpr
+        if not (pfx.rep or pfx.repne):
+            uop.opc = OPC_INVALID
+            return
+        uop.opc = OPC_SSEFP
+        uop.sub = FP_CVT_F2I_T if op == 0x2C else FP_CVT_F2I
+        uop.srcsize, uop.sext = (8 if pfx.repne else 4), 0
+        uop.opsize = 8 if pfx.rex_w else 4
+        modrm = _ModRM(cur, pfx)
+        _reg_operand(uop, modrm, pfx, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        return
+
+    if op == 0x5A:  # cvtss2sd/cvtsd2ss/cvtps2pd/cvtpd2ps
+        uop.opc, uop.sub = OPC_SSEFP, FP_CVT_F2F
+        uop.srcsize, uop.sext = fp_elem()  # SOURCE element type
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        return
+
+    if op == 0x5B:  # cvtdq2ps / cvtps2dq (66) / cvttps2dq (F3)
+        if pfx.repne:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc = OPC_SSEFP
+        uop.sub = (FP_CVT_PS2DQ_T if pfx.rep
+                   else FP_CVT_PS2DQ if pfx.osize else FP_CVT_DQ2PS)
+        uop.srcsize, uop.sext = 4, 1
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        return
+
+    if op == 0xE6:  # cvtdq2pd (F3) / cvtpd2dq (F2) / cvttpd2dq (66)
+        if pfx.rep:
+            sub = FP_CVT_DQ2PD
+        elif pfx.repne:
+            sub = FP_CVT_PD2DQ
+        elif pfx.osize:
+            sub = FP_CVT_PD2DQ_T
+        else:
+            uop.opc = OPC_INVALID  # bare E6 is MMX-era invalid
+            return
+        uop.opc, uop.sub = OPC_SSEFP, sub
+        uop.srcsize, uop.sext = 8, 1
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        return
+
+    if op in (0x14, 0x15):  # unpcklps/pd, unpckhps/pd
+        if pfx.rep or pfx.repne:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc = OPC_SSEFP
+        uop.sub = FP_UNPCKL if op == 0x14 else FP_UNPCKH
+        uop.srcsize, uop.sext = (8 if pfx.osize else 4), 1
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        return
+
+    if op == 0xC6:  # shufps/shufpd imm8
+        if pfx.rep or pfx.repne:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc, uop.sub = OPC_SSEFP, FP_SHUF
+        uop.srcsize, uop.sext = (8 if pfx.osize else 4), 1
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        xmm_reg(modrm, is_dst=True)
+        xmm_rm(modrm, is_dst=False)
+        uop.imm = cur.u8()
+        return
+
     sse_table = {
         0x57: SSE_XORPS, 0xEF: SSE_PXOR, 0xEB: SSE_POR, 0xDB: SSE_PAND,
         0xDF: SSE_PANDN, 0x74: SSE_PCMPEQB, 0x75: SSE_PCMPEQW,
         0x76: SSE_PCMPEQD, 0xF8: SSE_PSUBB, 0xFC: SSE_PADDB,
         0xDA: SSE_PMINUB, 0x6C: SSE_PUNPCKLQDQ,
+        # andps/andnps/orps and the pd forms: bitwise-identical to the
+        # integer logicals for every prefix variant (like 0x57 above)
+        0x54: SSE_PAND, 0x55: SSE_PANDN, 0x56: SSE_POR,
     }
     if op in (0x62, 0xD4):  # punpckldq / paddq: 66-prefixed only (no MMX)
         if not pfx.osize:

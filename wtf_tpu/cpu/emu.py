@@ -372,6 +372,10 @@ class EmuCpu:
             addr += self.gpr[uop.base_reg]
         if uop.idx_reg != U.REG_NONE:
             addr += self.gpr[uop.idx_reg] * uop.scale
+        if uop.a32:
+            # 67h truncates the un-segmented EA to 32 bits (SDM 64-bit
+            # address-size override), BEFORE the segment base applies
+            addr &= 0xFFFF_FFFF
         if uop.seg == U.SEG_FS:
             addr += self.fs_base
         elif uop.seg == U.SEG_GS:
@@ -755,6 +759,8 @@ class EmuCpu:
             self._exec_ssemov(uop, ea)
         elif opc == U.OPC_SSEALU:
             self._exec_ssealu(uop, ea)
+        elif opc == U.OPC_SSEFP:
+            self._exec_ssefp(uop, ea)
         elif opc in (U.OPC_INT, U.OPC_HLT, U.OPC_INT1):
             raise GuestCrash(self.rip, uop)
         else:
@@ -966,6 +972,10 @@ class EmuCpu:
             self.write_reg(2, uop.opsize, r & mask)
 
     def _exec_string(self, uop, opsize) -> bool:
+        if uop.a32:
+            # 67h string forms address via 32-bit rsi/rdi/rcx — not
+            # modeled; refuse rather than run with 64-bit registers
+            raise UnsupportedInsn(self.rip, uop.raw)
         """One string-op iteration; returns True when rip should advance."""
         if uop.rep != U.REP_NONE and self.gpr[1] == 0:  # rcx
             return True
@@ -1261,6 +1271,251 @@ class EmuCpu:
         else:
             raise UnsupportedInsn(self.rip, uop.raw)
         self._write_xmm_bytes(uop.dst_reg, out, merge=False)
+
+    def _exec_ssefp(self, uop, ea) -> None:
+        """SSE/SSE2 floating point (OPC_SSEFP) — semantics in _SseFp."""
+        sub = uop.sub
+        elem = uop.srcsize
+        packed = uop.sext == 1
+        fp = _SseFp(elem)
+        n = (16 // elem) if packed else 1
+
+        def src_bytes(nbytes):
+            if uop.src_kind == U.K_XMM:
+                return self._read_xmm_bytes(uop.src_reg, nbytes)
+            return self.virt_read(ea, nbytes)
+
+        def split(b, count):
+            return [b[i * elem:(i + 1) * elem] for i in range(count)]
+
+        # integer-involved converts first (different operand shapes)
+        if sub == U.FP_CVT_I2F:
+            if uop.src_kind == U.K_REG:
+                ival = self.read_reg(uop.src_reg, uop.opsize)
+            else:
+                ival = self.read_u(ea, uop.opsize)
+            ival = _sx(ival, uop.opsize * 8)
+            # int64 -> float32 must round ONCE (cvtsi2ss semantics):
+            # numpy's int64.astype(float32) is the direct C cast
+            out = fp.np.asarray(ival, dtype=fp.np.int64).astype(
+                fp.fdt).tobytes()
+            self._write_xmm_bytes(uop.dst_reg, out, merge=True)
+            return
+        if sub in (U.FP_CVT_F2I, U.FP_CVT_F2I_T):
+            b = src_bytes(elem)
+            r = fp.to_int(b, uop.opsize * 8, sub == U.FP_CVT_F2I_T)
+            self.write_reg(uop.dst_reg, uop.opsize, r)
+            return
+        if sub in (U.FP_UCOMI, U.FP_COMI):
+            a_b = self._read_xmm_bytes(uop.dst_reg, elem)
+            b_b = src_bytes(elem)
+            if fp.isnan(a_b) or fp.isnan(b_b):
+                zf = pf = cf = True
+            else:
+                a, b = fp.f(a_b), fp.f(b_b)
+                zf, pf, cf = a == b, False, a < b
+            self.set_flags(zf=zf, pf=pf, cf=cf, of=False, af=False, sf=False)
+            return
+
+        dst16 = self._read_xmm_bytes(uop.dst_reg, 16)
+        if sub == U.FP_CVT_F2F:
+            np = fp.np
+            dst_elem = 12 - elem  # 4 <-> 8
+            dst_dt = np.dtype("<f4") if dst_elem == 4 else np.dtype("<f8")
+            count = 2 if packed else 1
+            src = split(src_bytes(elem * count), count)
+            vals = [np.frombuffer(b, dtype=fp.fdt)[0] for b in src]
+            with np.errstate(all="ignore"):
+                out = b"".join(np.asarray(v, dtype=dst_dt).tobytes()
+                               for v in vals)
+            if packed:
+                # cvtps2pd fills 16; cvtpd2ps writes low 8, zeroes high
+                out = out.ljust(16, b"\x00")
+                self._write_xmm_bytes(uop.dst_reg, out, merge=False)
+            else:
+                self._write_xmm_bytes(uop.dst_reg, out, merge=True)
+            return
+        if sub in (U.FP_CVT_DQ2PS, U.FP_CVT_PS2DQ, U.FP_CVT_PS2DQ_T,
+                   U.FP_CVT_DQ2PD, U.FP_CVT_PD2DQ, U.FP_CVT_PD2DQ_T):
+            np = fp.np
+            src = src_bytes(16)
+            if sub == U.FP_CVT_DQ2PS:
+                ints = np.frombuffer(src, dtype="<i4")
+                out = ints.astype("<f4").tobytes()
+            elif sub == U.FP_CVT_DQ2PD:
+                ints = np.frombuffer(src[:8], dtype="<i4")
+                out = ints.astype("<f8").tobytes()
+            else:
+                fp_in = _SseFp(4 if sub in (U.FP_CVT_PS2DQ,
+                                            U.FP_CVT_PS2DQ_T) else 8)
+                count = 16 // fp_in.elem
+                trunc = sub in (U.FP_CVT_PS2DQ_T, U.FP_CVT_PD2DQ_T)
+                pieces = [fp_in.to_int(b, 32, trunc).to_bytes(4, "little")
+                          for b in (src[i * fp_in.elem:(i + 1) * fp_in.elem]
+                                    for i in range(count))]
+                out = b"".join(pieces).ljust(16, b"\x00")
+            self._write_xmm_bytes(uop.dst_reg, out, merge=False)
+            return
+
+        # element-wise forms over the common (dst, src) vector shape
+        src_v = split(src_bytes(16 if packed else elem), n)
+        dst_v = split(dst16, n)
+        if sub in (U.FP_ADD, U.FP_SUB, U.FP_MUL, U.FP_DIV):
+            out_v = [fp.arith(sub, d, s) for d, s in zip(dst_v, src_v)]
+        elif sub in (U.FP_MIN, U.FP_MAX):
+            out_v = [fp.minmax(sub, d, s) for d, s in zip(dst_v, src_v)]
+        elif sub == U.FP_SQRT:
+            out_v = [fp.sqrt(s) for s in src_v]
+        elif sub == U.FP_CMP:
+            mask = (b"\xFF" * elem, b"\x00" * elem)
+            out_v = [mask[0] if fp.cmp(uop.imm & 7, d, s) else mask[1]
+                     for d, s in zip(dst_v, src_v)]
+        elif sub == U.FP_SHUF:
+            src16 = src_bytes(16)
+            sel = uop.imm
+            if elem == 4:
+                picks = [dst16, dst16, src16, src16]
+                out_v = [picks[i][((sel >> (2 * i)) & 3) * 4:
+                                  ((sel >> (2 * i)) & 3) * 4 + 4]
+                         for i in range(4)]
+            else:
+                out_v = [dst16[(sel & 1) * 8:(sel & 1) * 8 + 8],
+                         src16[((sel >> 1) & 1) * 8:((sel >> 1) & 1) * 8 + 8]]
+        elif sub in (U.FP_UNPCKL, U.FP_UNPCKH):
+            src16 = src_bytes(16)
+            d_v, s_v = split(dst16, 16 // elem), split(src16, 16 // elem)
+            half = len(d_v) // 2
+            base = 0 if sub == U.FP_UNPCKL else half
+            out_v = []
+            for i in range(half):
+                out_v += [d_v[base + i], s_v[base + i]]
+        else:
+            raise UnsupportedInsn(self.rip, uop.raw)
+        out = b"".join(out_v)
+        self._write_xmm_bytes(uop.dst_reg, out, merge=not packed)
+        return
+
+
+class _SseFp:
+    """SSE/SSE2 floating-point semantics for the oracle (OPC_SSEFP).
+
+    Exact IEEE-754 via numpy (single-precision ops computed in float32 —
+    no double rounding) with the x86 rules handled at the bit level: NaN
+    payloads preserved, SNaNs quieted, the dst-operand NaN wins for
+    arithmetic, min/max/cmp forward the SECOND operand on NaN/equality,
+    out-of-range converts produce the integer indefinite.  Oracle-only by
+    design: the census over real Windows PEs (tools/decode_census.py)
+    shows FP dominates the decode gap, but snapshot-fuzzing guests run
+    integer-heavy paths, so trapping FP to the host costs little.
+    """
+
+    def __init__(self, elem: int):
+        import numpy as np
+
+        self.np = np
+        self.elem = elem
+        self.fdt = np.dtype("<f4") if elem == 4 else np.dtype("<f8")
+
+    def f(self, b: bytes):
+        return self.np.frombuffer(b[:self.elem], dtype=self.fdt)[0]
+
+    def bits(self, x) -> bytes:
+        return self.np.asarray(x, dtype=self.fdt).tobytes()
+
+    def isnan(self, b: bytes) -> bool:
+        return bool(self.np.isnan(self.f(b)))
+
+    def quiet(self, b: bytes) -> bytes:
+        out = bytearray(b[:self.elem])
+        if self.elem == 4:
+            out[2] |= 0x40  # f32 QNaN bit 22
+        else:
+            out[6] |= 0x08  # f64 QNaN bit 51
+        return bytes(out)
+
+    @property
+    def indefinite(self) -> bytes:
+        return (b"\x00\x00\xC0\xFF" if self.elem == 4
+                else b"\x00\x00\x00\x00\x00\x00\xF8\xFF")
+
+    def arith(self, sub: int, a_b: bytes, b_b: bytes) -> bytes:
+        import wtf_tpu.cpu.uops as U
+
+        np = self.np
+        if self.isnan(a_b):
+            return self.quiet(a_b)
+        if self.isnan(b_b):
+            return self.quiet(b_b)
+        a, b = self.f(a_b), self.f(b_b)
+        with np.errstate(all="ignore"):
+            if sub == U.FP_ADD:
+                r = a + b
+            elif sub == U.FP_SUB:
+                r = a - b
+            elif sub == U.FP_MUL:
+                r = a * b
+            else:  # FP_DIV
+                r = a / b
+        if np.isnan(r):  # invalid operation (inf-inf, 0*inf, 0/0, inf/inf)
+            return self.indefinite
+        return self.bits(r)
+
+    def minmax(self, sub: int, a_b: bytes, b_b: bytes) -> bytes:
+        import wtf_tpu.cpu.uops as U
+
+        # SDM MINSS: NaN (either), or equal values (incl. ±0): the SECOND
+        # operand is returned unchanged
+        if self.isnan(a_b) or self.isnan(b_b):
+            return b_b[:self.elem]
+        a, b = self.f(a_b), self.f(b_b)
+        if a == b:
+            return b_b[:self.elem]
+        take_a = a < b if sub == U.FP_MIN else a > b
+        return a_b[:self.elem] if take_a else b_b[:self.elem]
+
+    def sqrt(self, b_b: bytes) -> bytes:
+        np = self.np
+        if self.isnan(b_b):
+            return self.quiet(b_b)
+        v = self.f(b_b)
+        if v < 0:
+            return self.indefinite  # sqrt(-x) -> real indefinite
+        with np.errstate(all="ignore"):
+            return self.bits(np.sqrt(v))
+
+    def cmp(self, pred: int, a_b: bytes, b_b: bytes) -> bool:
+        unord = self.isnan(a_b) or self.isnan(b_b)
+        a, b = self.f(a_b), self.f(b_b)
+        if pred == 0:
+            return not unord and a == b
+        if pred == 1:
+            return not unord and a < b
+        if pred == 2:
+            return not unord and a <= b
+        if pred == 3:
+            return unord
+        if pred == 4:
+            return unord or a != b
+        if pred == 5:
+            return unord or not a < b
+        if pred == 6:
+            return unord or not a <= b
+        return not unord  # 7: ord
+
+    def to_int(self, b_b: bytes, int_bits: int, truncate: bool) -> int:
+        """cvt(t)ss/sd2si: rounded (half-even) or truncated, with the
+        integer-indefinite on NaN/overflow."""
+        np = self.np
+        indefinite = 1 << (int_bits - 1)
+        if self.isnan(b_b):
+            return indefinite
+        v = float(self.f(b_b))
+        if v != v or v in (float("inf"), float("-inf")):
+            return indefinite
+        r = int(v) if truncate else int(np.rint(np.asarray(v)))
+        if not -(1 << (int_bits - 1)) <= r < (1 << (int_bits - 1)):
+            return indefinite
+        return r & ((1 << int_bits) - 1)
 
 
 class GuestCrash(Exception):

@@ -88,8 +88,39 @@ OPC_MSR = 47       # rdmsr/wrmsr (sub: 0 read, 1 write); oracle-serviced
 OPC_VZEROALL = 48  # vzeroall: zeroes xmm0-15 (no YMM state in this
                    # model); oracle-serviced — rare enough not to earn a
                    # device path
+OPC_SSEFP = 49     # SSE/SSE2 floating point (sub FP_*; srcsize = element
+                   # width 4/8, sext = 1 for packed forms).  The dominant
+                   # decode gap measured on real Windows-PE codegen
+                   # (tools/decode_census.py); oracle-serviced — guests in
+                   # the snapshot-fuzzing domain run integer-heavy paths,
+                   # so FP trapping to the host costs little
 
-N_OPC = 49
+N_OPC = 50
+
+# OPC_SSEFP sub-operations
+FP_ADD = 0
+FP_SUB = 1
+FP_MUL = 2
+FP_DIV = 3
+FP_MIN = 4
+FP_MAX = 5
+FP_SQRT = 6
+FP_UCOMI = 7      # ucomiss/ucomisd: rflags only
+FP_COMI = 8       # comiss/comisd (same flag image; #IA differences N/A)
+FP_CMP = 9        # cmpps/ss/pd/sd imm8 predicate -> all-ones/zeros mask
+FP_CVT_I2F = 10   # cvtsi2ss/sd (gpr/mem int -> fp scalar)
+FP_CVT_F2I = 11   # cvtss2si/cvtsd2si (rounded)
+FP_CVT_F2I_T = 12 # cvttss2si/cvttsd2si (truncated)
+FP_CVT_F2F = 13   # cvtss2sd/cvtsd2ss/cvtps2pd/cvtpd2ps
+FP_CVT_DQ2PS = 14 # cvtdq2ps
+FP_CVT_PS2DQ = 15 # cvtps2dq (rounded)
+FP_CVT_PS2DQ_T = 16  # cvttps2dq
+FP_SHUF = 17      # shufps/shufpd imm8
+FP_UNPCKL = 18    # unpcklps/unpcklpd
+FP_UNPCKH = 19    # unpckhps/unpckhpd
+FP_CVT_DQ2PD = 20 # cvtdq2pd (F3 0F E6 is pd->dq; E6/5A family)
+FP_CVT_PD2DQ = 21 # cvtpd2dq (F2 0F E6, rounded)
+FP_CVT_PD2DQ_T = 22  # cvttpd2dq (66 0F E6)
 
 # RFLAGS bits writable by flag-image restores (sysret r11, iretq frame):
 # CF PF AF ZF SF TF IF DF OF IOPL NT AC VIF VIP ID.  RF (bit 16) and VM
@@ -184,6 +215,7 @@ class Uop:
     seg: int = SEG_NONE
     rep: int = REP_NONE
     lock: int = 0
+    a32: int = 0               # 67h: effective address truncated to 32 bits
     raw: bytes = b""           # original bytes (debug / SMC verification)
 
     def mem_operand(self) -> bool:
@@ -194,6 +226,6 @@ class Uop:
 INT_FIELDS = (
     "opc", "sub", "cond", "length", "opsize", "srcsize", "sext",
     "dst_kind", "dst_reg", "src_kind", "src_reg",
-    "base_reg", "idx_reg", "scale", "seg", "rep", "lock",
+    "base_reg", "idx_reg", "scale", "seg", "rep", "lock", "a32",
 )
 U64_FIELDS = ("disp", "imm")

@@ -42,6 +42,7 @@ from wtf_tpu.cpu import uops as U
 from wtf_tpu.cpu.cpuid import CPUID_TABLE, MAX_BASIC_LEAF
 from wtf_tpu.interp.machine import Machine
 from wtf_tpu.interp.uoptable import (
+    F_A32,
     F_BASE_REG, F_COND, F_DST_KIND, F_DST_REG, F_IDX_REG, F_LENGTH, F_LOCK,
     F_OPC, F_OPSIZE, F_REP, F_SCALE, F_SEG, F_SEXT, F_SRCSIZE, F_SRC_KIND,
     F_SRC_REG, F_SUB, M_BP, M_PFN0, M_PFN1, MU_DISP, MU_IMM, MU_RAW_HI,
@@ -401,8 +402,11 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     unsupported = pre_live & (
         is_(U.OPC_INVALID) | is_(U.OPC_IRET) | is_(U.OPC_MSR)
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
-        | is_(U.OPC_STACKSTR) | is_(U.OPC_VZEROALL)
+        | is_(U.OPC_STACKSTR) | is_(U.OPC_VZEROALL) | is_(U.OPC_SSEFP)
         | (is_(U.OPC_RDGSBASE) & (sub != 4))
+        # 67h string forms use 32-bit rsi/rdi/rcx; neither engine models
+        # that — surface loudly instead of executing with 64-bit regs
+        | (is_string & (f[F_A32] != 0))
         | movcr_bad | div64_hard)
 
     # -- 4a. effective address -------------------------------------------
@@ -410,7 +414,11 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     idx_val = _read64(gpr, ireg) * scale.astype(jnp.uint64)
     seg_base = jnp.where(seg == U.SEG_FS, st.fs_base,
                          jnp.where(seg == U.SEG_GS, st.gs_base, _u(0)))
-    ea = disp + base_val + idx_val + seg_base
+    # 67h: the un-segmented EA truncates to 32 bits BEFORE the segment
+    # base is applied (SDM address-size override in 64-bit mode)
+    ea_flat = disp + base_val + idx_val
+    ea_flat = jnp.where(f[F_A32] != 0, ea_flat & _u(0xFFFF_FFFF), ea_flat)
+    ea = ea_flat + seg_base
 
     # BT bit-string addressing: register bit index moves the EA by opsize
     # for every `bits` of signed offset (emu _exec_bt).

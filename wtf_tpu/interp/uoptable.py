@@ -57,6 +57,7 @@ F_SCALE = _FIELD_INDEX["scale"]
 F_SEG = _FIELD_INDEX["seg"]
 F_REP = _FIELD_INDEX["rep"]
 F_LOCK = _FIELD_INDEX["lock"]
+F_A32 = _FIELD_INDEX["a32"]
 
 
 class UopTable(NamedTuple):
