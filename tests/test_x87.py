@@ -1,0 +1,279 @@
+"""x87 subset vs the REAL host CPU (VERDICT r3 item 3, 'x87/MMX minimal').
+
+Protocol: operands ride in GPRs, cross into the FPU through stack memory,
+and results come back the same way.  Every snippet pins PC=53 via fldcw
+(the control word Windows runs with) so the oracle's double-precision
+value model is bit-exact against hardware for the whole f64 range; the
+host's original control word is restored before returning.
+"""
+
+import struct
+
+import pytest
+
+from emurunner import run_emu
+from nativeharness import run_native
+from wtf_tpu.core.cpustate import GPR_NAMES
+
+F64 = {
+    "one5": 0x3FF8000000000000,        # 1.5
+    "two25": 0x4002000000000000,       # 2.25
+    "neg42": 0xC045000000000000,
+    "pi": 0x400921FB54442D18,
+    "e": 0x4005BF0A8B145769,
+    "pzero": 0x0000000000000000,
+    "nzero": 0x8000000000000000,
+    "pinf": 0x7FF0000000000000,
+    "ninf": 0xFFF0000000000000,
+    "qnan": 0x7FF8000000005678,
+    "denorm": 0x0000000000000001,
+    "tiny": 0x0010000000000000,
+    "big": 0x7FE0123456789ABC,
+}
+
+_PRELUDE = """
+    sub rsp, 40
+    fnstcw [rsp+32]               # save the host control word
+    mov word ptr [rsp+34], 0x27F  # PC=53, all exceptions masked
+    fldcw [rsp+34]
+    mov [rsp], rax
+    mov [rsp+8], rcx
+"""
+_EPILOGUE = """
+    fldcw [rsp+32]                # restore the host control word
+    add rsp, 40
+"""
+
+
+def _run_both(snippet, init_regs):
+    init = [0] * 16
+    for name, value in init_regs.items():
+        init[GPR_NAMES.index(name)] = value
+    hw_regs, hw_flags = run_native(snippet, init)
+    regs = {n: v for n, v in zip(GPR_NAMES, init) if n != "rsp"}
+    cpu = run_emu(snippet + "\nhlt", regs=regs)
+    return hw_regs, hw_flags, cpu
+
+
+@pytest.mark.parametrize("body", [
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfaddp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfsubp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfsubrp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfmulp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfdivp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfdivrp st(1), st",
+    "fld qword ptr [rsp]\nfadd qword ptr [rsp+8]",
+    "fld qword ptr [rsp]\nfmul qword ptr [rsp+8]",
+    "fld qword ptr [rsp]\nfdiv qword ptr [rsp+8]",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfadd st, st(1)\n"
+    "fstp st(1)",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfxch\nfsubp st(1), st",
+    "fld qword ptr [rsp]\nfchs",
+    "fld qword ptr [rsp]\nfabs",
+    "fld1\nfld qword ptr [rsp]\nfaddp st(1), st",
+    "fldz\nfld qword ptr [rsp]\nfsubp st(1), st",
+])
+@pytest.mark.parametrize("a_name,b_name", [
+    ("one5", "two25"), ("pi", "e"), ("neg42", "one5"), ("big", "tiny"),
+    ("pinf", "ninf"), ("qnan", "one5"), ("pzero", "nzero"),
+    ("denorm", "denorm"),
+])
+def test_x87_arith_vs_hardware(body, a_name, b_name):
+    snippet = (_PRELUDE + body
+               + "\nfstp qword ptr [rsp+16]\nmov rax, [rsp+16]"
+               + _EPILOGUE)
+    hw_regs, _, cpu = _run_both(
+        snippet, {"rax": F64[a_name], "rcx": F64[b_name]})
+    assert cpu.gpr[0] == hw_regs[0], (
+        f"{body.splitlines()[-1]}({a_name},{b_name}): "
+        f"emu={cpu.gpr[0]:#018x} hw={hw_regs[0]:#018x}")
+
+
+@pytest.mark.parametrize("ival", [0, 1, -1 & (1 << 64) - 1, 123456789,
+                                  0xFFFFFFFF00000000, 1 << 52])
+def test_fild_fistp_vs_hardware(ival):
+    snippet = (_PRELUDE
+               + "fild qword ptr [rsp]\nfistp qword ptr [rsp+16]\n"
+               + "mov rax, [rsp+16]" + _EPILOGUE)
+    hw_regs, _, cpu = _run_both(snippet, {"rax": ival})
+    assert cpu.gpr[0] == hw_regs[0], f"{ival:#x}"
+
+
+@pytest.mark.parametrize("a_name,b_name", [
+    ("one5", "two25"), ("two25", "one5"), ("one5", "one5"),
+    ("qnan", "one5"), ("pinf", "big"),
+])
+def test_fcomi_and_fnstsw_vs_hardware(a_name, b_name):
+    snippet = (_PRELUDE + """
+    fld qword ptr [rsp+8]
+    fld qword ptr [rsp]
+    fcomip st, st(1)
+    pushfq
+    pop r8                        # flags BEFORE the epilogue's add rsp
+    fstp st(0)
+    fld qword ptr [rsp+8]
+    fld qword ptr [rsp]
+    fucompp
+    fnstsw ax
+    movzx rdx, ax
+    and rdx, 0x4700
+""" + _EPILOGUE)
+    hw_regs, hw_flags, cpu = _run_both(
+        snippet, {"rax": F64[a_name], "rcx": F64[b_name]})
+    mask = 0x8D5
+    assert cpu.gpr[8] & mask == hw_regs[8] & mask, (
+        f"fcomip({a_name},{b_name}): emu={cpu.gpr[8]:#x} hw={hw_regs[8]:#x}")
+    assert cpu.gpr[2] == hw_regs[2], (
+        f"fnstsw C-codes: emu={cpu.gpr[2]:#x} hw={hw_regs[2]:#x}")
+
+
+def test_fxsave_fxrstor_roundtrip():
+    """FXSAVE writes the real 512-byte image (control words, abridged tag,
+    80-bit ST slots, XMM0-15); FXRSTOR restores it — the context-switch
+    path real ntoskrnl images hit (oracle-level; the image layout itself
+    is the contract)."""
+    from emurunner import run_emu
+
+    area = 0x2000_0000
+    cpu = run_emu(
+        f"""
+        mov rbx, {area}
+        mov rax, 0x3FF8000000000000
+        mov [rbx+0x600], rax
+        fld qword ptr [rbx+0x600]     # st0 = 1.5
+        mov rax, 0x1122334455667788
+        movq xmm5, rax
+        fxsave [rbx]
+        fstp st(0)                    # clobber the FPU...
+        fldz
+        fstp st(0)
+        pxor xmm5, xmm5               # ...and xmm5
+        fxrstor [rbx]                 # bring everything back
+        fstp qword ptr [rbx+0x608]
+        mov rax, [rbx+0x608]
+        movq rcx, xmm5
+        hlt
+        """,
+        data={area: bytes(0x1000)})
+    assert cpu.gpr[0] == 0x3FF8000000000000   # st0 survived the roundtrip
+    assert cpu.gpr[1] == 0x1122334455667788   # xmm5 too
+    # saved image: fcw at +0, abridged tag nonzero, st0 as 80-bit at +32
+    img = cpu.virt_read(area, 512)
+    fcw = struct.unpack_from("<H", img, 0)[0]
+    assert fcw in (0x27F, 0x37F)
+    assert img[4] != 0
+    v80 = int.from_bytes(img[32:42], "little")
+    assert v80 >> 64 == 0x3FFF                # exponent of 1.5
+    assert img[160 + 16 * 5:160 + 16 * 5 + 8] == bytes.fromhex(
+        "8877665544332211")
+
+
+def test_ldmxcsr_stmxcsr_move_real_state():
+    low = 0x2000_0000
+    cpu = run_emu(
+        f"""
+        mov rbx, {low}
+        mov dword ptr [rbx], 0x9FC0   # FZ|DAZ-ish pattern
+        ldmxcsr [rbx]
+        stmxcsr [rbx+4]
+        mov eax, [rbx+4]
+        hlt
+        """,
+        data={low: bytes(16)})
+    assert cpu.gpr[0] == 0x9FC0
+    assert cpu.mxcsr == 0x9FC0
+
+
+@pytest.mark.parametrize("rc,name", [(0, "nearest"), (1, "down"),
+                                     (2, "up"), (3, "chop")])
+@pytest.mark.parametrize("val_bits", [
+    0x4005999999999999,   # 2.7
+    0xC005999999999999,   # -2.7
+    0x4004000000000000,   # 2.5 (ties: nearest-even -> 2)
+    0x400C000000000000,   # 3.5 (ties -> 4)
+])
+def test_fistp_honors_rounding_control(rc, name, val_bits):
+    """fist(p) must honor fpcw.RC — the pre-SSE truncation idiom rewrites
+    RC around the store (code-review r4 finding)."""
+    cw = 0x27F | (rc << 10)
+    snippet = (f"""
+    sub rsp, 40
+    fnstcw [rsp+32]
+    mov word ptr [rsp+34], {cw:#x}
+    fldcw [rsp+34]
+    mov [rsp], rax
+    fld qword ptr [rsp]
+    fistp qword ptr [rsp+16]
+    mov rax, [rsp+16]
+    fldcw [rsp+32]
+    add rsp, 40
+""")
+    hw_regs, _, cpu = _run_both(snippet, {"rax": val_bits})
+    assert cpu.gpr[0] == hw_regs[0], (
+        f"RC={name} {val_bits:#x}: emu={cpu.gpr[0]:#x} hw={hw_regs[0]:#x}")
+
+
+def test_80bit_fpst_snapshot_loads_correctly():
+    """A snapshot whose fpst carries live 80-bit extended values (real
+    bdump dumps) must reduce to the right doubles, not keep the raw low
+    64 mantissa bits (code-review r4 finding)."""
+    import json
+    import tempfile
+    from pathlib import Path
+
+    from wtf_tpu.core.cpustate import load_cpu_state_json
+    from wtf_tpu.cpu.emu import _f80_to_f64_bits
+
+    f80_15 = 0x3FFFC000000000000000          # 1.5 in 80-bit extended
+    f80_neg = 0xC000A000000000000000          # -2.5
+    with tempfile.TemporaryDirectory() as tmp:
+        p = Path(tmp) / "regs.json"
+        p.write_text(json.dumps({
+            "rip": "0x1000", "fptw": "0x0",
+            "fpst": [hex(f80_15), hex(f80_neg)] + ["0x0"] * 6,
+        }))
+        state = load_cpu_state_json(p)
+    assert state.fpst[0] == f80_15            # parse keeps full precision
+    assert _f80_to_f64_bits(f80_15) == 0x3FF8000000000000
+    assert _f80_to_f64_bits(f80_neg) == 0xC004000000000000
+    # the oracle reduces on load
+    from emurunner import build_guest
+    from wtf_tpu.cpu.emu import EmuCpu, EmuMem
+    from wtf_tpu.mem.physmem import PhysMem
+
+    physmem, cpustate, _ = build_guest("nop\nhlt")
+    cpustate.fpst = [f80_15, f80_neg] + [0] * 6
+    cpu = EmuCpu(EmuMem(physmem), cpustate)
+    assert cpu.fpst[0] == 0x3FF8000000000000
+    assert cpu.fpst[1] == 0xC004000000000000
+    # and the device machine broadcast does the same reduction
+    from wtf_tpu.interp.machine import _fpst_f64_bits
+
+    assert _fpst_f64_bits(f80_15) == 0x3FF8000000000000
+
+
+def test_vex_three_op_degenerate_forms_decode():
+    """VEX src1==dst degenerate encodings MSVC /arch:AVX emits
+    (code-review r4 finding): scalar converts, vmovlps loads, vpslldq."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from asmhelper import assemble
+    from wtf_tpu.cpu.decoder import decode
+    from wtf_tpu.cpu.uops import OPC_INVALID, OPC_SSEALU, OPC_SSEFP, \
+        OPC_SSEMOV
+
+    pad = b"\x90" * 12
+    assert decode(assemble("vcvtsd2ss xmm1, xmm1, xmm2") + pad).opc \
+        == OPC_SSEFP
+    assert decode(assemble("vcvtss2sd xmm3, xmm3, [rax]") + pad).opc \
+        == OPC_SSEFP
+    assert decode(assemble("vmovlps xmm1, xmm1, [rax]") + pad).opc \
+        == OPC_SSEMOV
+    assert decode(assemble("vmovhps xmm2, xmm2, [rbx]") + pad).opc \
+        == OPC_SSEMOV
+    assert decode(assemble("vpslldq xmm4, xmm4, 3") + pad).opc == OPC_SSEALU
+    assert decode(assemble("vpsrldq xmm9, xmm9, 5") + pad).opc == OPC_SSEALU
+    # non-degenerate 3-operand forms stay rejected
+    assert decode(assemble("vcvtsd2ss xmm1, xmm2, xmm3") + pad).opc \
+        == OPC_INVALID
+    assert decode(assemble("vpslldq xmm4, xmm5, 3") + pad).opc == OPC_INVALID

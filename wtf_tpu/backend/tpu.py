@@ -283,10 +283,12 @@ class TpuBackend(Backend):
         cpu.rflags = int(view.r["rflags"][0])
         for name in ("fs_base", "gs_base", "kernel_gs_base", "cr0", "cr2",
                      "cr3", "cr4", "cr8", "lstar", "star", "sfmask", "efer",
-                     "tsc"):
+                     "tsc", "fpcw", "fpsw", "fptw", "mxcsr"):
             setattr(cpu, name, int(view.r[name][0]))
         cpu.cs_sel = int(view.r["cs"][0])
         cpu.ss_sel = int(view.r["ss"][0])
+        cpu.fpst = [int(v) for v in view.r["fpst"][0]]
+        cpu.fptop = (int(view.r["fpsw"][0]) >> 11) & 7
         for i in range(16):
             cpu.xmm[i][0] = int(view.r["xmm"][0, i, 0])
             cpu.xmm[i][1] = int(view.r["xmm"][0, i, 1])

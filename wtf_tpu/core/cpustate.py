@@ -245,14 +245,17 @@ def load_cpu_state_json(path) -> CpuState:
 
     # x87 stack slots: bdump emits "0xInfinity"-ish strings when the FPU
     # state was never materialized; treat those as zero and force an empty
-    # tag word if everything was empty (utils.cc:156-191).
+    # tag word if everything was empty (utils.cc:156-191).  NOT masked to
+    # 64 bits: live entries are 80-bit extended values — consumers reduce
+    # them to the double model (cpu/emu.py _f80_to_f64_bits).
     all_slots_zero = True
     if "fpst" in data:
         for idx, value in enumerate(data["fpst"][:8]):
             if isinstance(value, str) and "Infinity" in value:
                 state.fpst[idx] = 0
             else:
-                state.fpst[idx] = _parse_u64(value)
+                state.fpst[idx] = (int(value, 0) if isinstance(value, str)
+                                   else int(value))
                 all_slots_zero = False
     if state.fptw == 0 and all_slots_zero:
         state.fptw = 0xFFFF

@@ -39,6 +39,13 @@ from wtf_tpu.cpu.uops import (
     FP_COMI, FP_CMP, FP_CVT_I2F, FP_CVT_F2I, FP_CVT_F2I_T, FP_CVT_F2F,
     FP_CVT_DQ2PS, FP_CVT_PS2DQ, FP_CVT_PS2DQ_T, FP_SHUF, FP_UNPCKL,
     FP_UNPCKH, FP_CVT_DQ2PD, FP_CVT_PD2DQ, FP_CVT_PD2DQ_T,
+    OPC_X87, X87_ARITH_M, X87_ARITH_ST, X87_COM, X87_COMI, X87_EMMS,
+    X87_FABS, X87_FCHS, X87_FFREE, X87_FILD, X87_FIST, X87_FIST_T,
+    X87_FLDCW, X87_FLD_CONST, X87_FLD_M, X87_FLD_STI, X87_FNCLEX,
+    X87_FNINIT, X87_FNSTCW, X87_FNSTSW_AX, X87_FNSTSW_M, X87_FST_M,
+    X87_FST_STI, X87_FXCH, X87_FXRSTOR, X87_FXSAVE, X87_LDMXCSR,
+    X87_STMXCSR, X87_OP_ADD, X87_OP_COM, X87_OP_COMP, X87_OP_DIV,
+    X87_OP_DIVR, X87_OP_MUL, X87_OP_SUB, X87_OP_SUBR,
     REG_AH_BASE, REG_NONE,
     REG_RIP, REP_NONE, REP_REP, REP_REPNE, SEG_FS, SEG_GS, SEG_NONE,
     SH_SHL, SH_SHLD, SH_SHRD, SSE_PADDB, SSE_PAND, SSE_PANDN, SSE_PCMPEQB,
@@ -356,6 +363,44 @@ def _decode_vex(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
 
     if l_bit:  # VEX.256 (AVX) — not in the scalar subset
         uop.opc = OPC_INVALID
+        return
+
+    if mmmmm == 1:
+        # VEX.128 forms of the 0F map: delegate to the legacy decoder
+        # with pp mapped onto the prefix flags.  Two-operand forms
+        # (moves, packed converts, ucomis) require VEX.vvvv == 1111b
+        # exactly like hardware; three-operand forms are accepted when
+        # vvvv names the destination — src1 == dst degenerates to the
+        # legacy read-modify-write semantics this pipeline models.  A
+        # genuinely three-operand encoding (vvvv != dst) stays INVALID.
+        pfx.osize = pp == 1
+        pfx.rep = pp == 2
+        pfx.repne = pp == 3
+        _decode_0f_sse(opc, cur, pfx, uop)
+        if uop.opc == OPC_INVALID:
+            return
+        mem = uop.mem_operand()
+        three_op = opc in (0x51, 0x58, 0x59, 0x5C, 0x5D, 0x5E, 0x5F,
+                           0xC2, 0x54, 0x55, 0x56, 0x57, 0x14, 0x15,
+                           0xC6, 0x2A, 0xEF, 0xEB, 0xDB, 0xDF, 0x74,
+                           0x75, 0x76, 0xF8, 0xFC, 0xDA, 0x6C, 0x62,
+                           0xD4,
+                           # 0x73: vpslldq/vpsrldq — VEX dst rides in vvvv,
+                           # degenerate when it names the same register
+                           0x73)
+        scalar_regmov = opc in (0x10, 0x11) and pp in (2, 3) and not mem
+        # scalar converts merge into vvvv (vcvtsd2ss etc.); packed 0x5A
+        # forms are 2-operand
+        scalar_cvt = opc == 0x5A and pp in (2, 3)
+        # vmovlps/vmovhps: both the load (mem) and the hl/lh reg forms
+        # merge into vvvv; the stores 0x13/0x17 are plain 2-operand
+        half_mov = opc in (0x12, 0x16)
+        if three_op or scalar_regmov or scalar_cvt or half_mov:
+            ok = vvvv == uop.dst_reg
+        else:
+            ok = vvvv == 0
+        if not ok:
+            uop.opc = OPC_INVALID
         return
 
     if mmmmm == 2:  # 0F38 map
@@ -779,6 +824,14 @@ def _decode_primary(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         uop.opc, uop.sub = OPC_FLAGOP, FL_STD
         return
 
+    if op == 0x9B:  # fwait: exception-check only; no deferred faults here
+        uop.opc = OPC_NOP
+        return
+
+    if 0xD8 <= op <= 0xDF:  # x87 escape block
+        _decode_x87(op, cur, pfx, uop)
+        return
+
     if op == 0xFE:  # group 4: inc/dec r/m8
         modrm = _ModRM(cur, pfx)
         sub = modrm.reg & 7
@@ -937,15 +990,20 @@ def _decode_0f(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         return
 
     if op == 0xAE:
-        # group 15: fences are nops; ldmxcsr/stmxcsr unsupported-but-harmless
+        # group 15: fences; ldmxcsr/stmxcsr and fxsave/fxrstor are real
+        # state movers (oracle-serviced via OPC_X87)
         modrm = _ModRM(cur, pfx)
         sub = modrm.reg & 7
         if not modrm.is_mem and sub in (5, 6, 7):  # lfence/mfence/sfence
             uop.opc = OPC_FENCE
-        elif modrm.is_mem and sub in (2, 3):  # ldmxcsr/stmxcsr
-            uop.opc = OPC_NOP
+        elif modrm.is_mem and sub in (0, 1, 2, 3):
+            uop.opc = OPC_X87
+            uop.sub = {0: X87_FXSAVE, 1: X87_FXRSTOR,
+                       2: X87_LDMXCSR, 3: X87_STMXCSR}[sub]
+            _apply_mem(uop, modrm, pfx)
+            uop.src_kind = K_MEM  # address carrier; width handled in exec
         else:
-            uop.opc = OPC_INVALID
+            uop.opc = OPC_INVALID  # xsave/xrstor/clflush out of subset
         return
 
     if op == 0xAF:  # imul r, r/m
@@ -1024,6 +1082,140 @@ def _decode_0f(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
     _decode_0f_sse(op, cur, pfx, uop)
 
 
+def _decode_x87(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
+    """x87 escape block D8-DF (OPC_X87, oracle-serviced).
+
+    Covers the load/store/arith/compare/control subset MSVC and CRT
+    helpers emit around `long double` and legacy math paths; the
+    transcendental/BCD/env instructions stay INVALID -> oracle
+    UnsupportedInsn.  Census note (tools/decode_census.py): x87 is ~0% of
+    modern Windows x64 .text — the x64 ABI is SSE-based and kernel code
+    may not use the FPU at all — so this subset is about not
+    false-crashing the stragglers, not about throughput."""
+    uop.opc = OPC_X87
+    modbyte = cur.peek()
+    if modbyte < 0xC0:  # memory form: reg digit selects the operation
+        modrm = _ModRM(cur, pfx)
+        digit = modrm.reg & 7
+        _apply_mem(uop, modrm, pfx)
+        uop.src_kind = K_MEM  # address carrier for exec
+        if op in (0xD8, 0xDC):  # fadd/fmul/fcom(p)/fsub(r)/fdiv(r) m32/m64
+            uop.sub = X87_ARITH_M
+            uop.cond = digit
+            uop.srcsize = 4 if op == 0xD8 else 8
+            if digit == X87_OP_COMP:
+                uop.sext = 1  # fcomp pops
+            return
+        table = {
+            (0xD9, 0): (X87_FLD_M, 4, 0), (0xD9, 2): (X87_FST_M, 4, 0),
+            (0xD9, 3): (X87_FST_M, 4, 1), (0xD9, 5): (X87_FLDCW, 2, 0),
+            (0xD9, 7): (X87_FNSTCW, 2, 0),
+            (0xDD, 0): (X87_FLD_M, 8, 0), (0xDD, 2): (X87_FST_M, 8, 0),
+            (0xDD, 3): (X87_FST_M, 8, 1), (0xDD, 7): (X87_FNSTSW_M, 2, 0),
+            (0xDB, 0): (X87_FILD, 4, 0), (0xDB, 1): (X87_FIST_T, 4, 1),
+            (0xDB, 2): (X87_FIST, 4, 0), (0xDB, 3): (X87_FIST, 4, 1),
+            (0xDD, 1): (X87_FIST_T, 8, 1),
+            (0xDF, 0): (X87_FILD, 2, 0), (0xDF, 2): (X87_FIST, 2, 0),
+            (0xDF, 3): (X87_FIST, 2, 1), (0xDF, 5): (X87_FILD, 8, 0),
+            (0xDF, 7): (X87_FIST, 8, 1),
+        }
+        entry = table.get((op, digit))
+        if entry is None:  # m80, fldenv/fstenv, fbld... out of subset
+            uop.opc = OPC_INVALID
+            return
+        uop.sub, uop.srcsize, uop.sext = entry
+        return
+
+    # register form
+    cur.u8()  # consume the modrm byte
+    i = modbyte & 7
+    uop.imm = i
+    _DSTI_SWAP = {X87_OP_SUB: X87_OP_SUBR, X87_OP_SUBR: X87_OP_SUB,
+                  X87_OP_DIV: X87_OP_DIVR, X87_OP_DIVR: X87_OP_DIV}
+    if op in (0xD8, 0xDC):  # arith st/st(i); DC: st(i) is the destination
+        uop.sub = X87_ARITH_ST
+        uop.cond = (modbyte >> 3) & 7
+        uop.dst_reg = 1 if op == 0xDC else 0
+        if op == 0xD8 and uop.cond == X87_OP_COMP:
+            uop.sext = 1
+        if op == 0xDC and uop.cond in (X87_OP_COM, X87_OP_COMP):
+            uop.opc = OPC_INVALID  # DC D0+ forms are reserved
+        if op == 0xDC:
+            # the SDM's famous reversal: with st(i) as destination the
+            # encoded digit means the OPPOSITE sub/div direction
+            uop.cond = _DSTI_SWAP.get(uop.cond, uop.cond)
+        return
+    if op == 0xDE:
+        if modbyte == 0xD9:  # fcompp
+            uop.sub, uop.cond, uop.sext = X87_COM, 0, 2
+            return
+        if (modbyte >> 3) & 7 in (X87_OP_COM, X87_OP_COMP):
+            uop.opc = OPC_INVALID
+            return
+        uop.sub = X87_ARITH_ST  # faddp/fmulp/fsub(r)p/fdiv(r)p st(i), st
+        uop.cond = _DSTI_SWAP.get((modbyte >> 3) & 7, (modbyte >> 3) & 7)
+        uop.dst_reg = 1
+        uop.sext = 1
+        return
+    if op == 0xD9:
+        if modbyte <= 0xC7:
+            uop.sub = X87_FLD_STI
+        elif modbyte <= 0xCF:
+            uop.sub = X87_FXCH
+        elif modbyte == 0xD0:
+            uop.opc = OPC_NOP  # fnop
+        elif modbyte == 0xE0:
+            uop.sub = X87_FCHS
+        elif modbyte == 0xE1:
+            uop.sub = X87_FABS
+        elif modbyte == 0xE8:
+            uop.sub, uop.imm = X87_FLD_CONST, 0  # fld1
+        elif modbyte == 0xEE:
+            uop.sub, uop.imm = X87_FLD_CONST, 1  # fldz
+        else:  # fptan/fsin/f2xm1... out of subset
+            uop.opc = OPC_INVALID
+        return
+    if op == 0xDD:
+        if 0xC0 <= modbyte <= 0xC7:
+            uop.sub = X87_FFREE
+        elif 0xD0 <= modbyte <= 0xD7:
+            uop.sub = X87_FST_STI
+        elif 0xD8 <= modbyte <= 0xDF:
+            uop.sub, uop.sext = X87_FST_STI, 1
+        elif 0xE0 <= modbyte <= 0xE7:
+            uop.sub, uop.cond = X87_COM, 0  # fucom
+        elif 0xE8 <= modbyte <= 0xEF:
+            uop.sub, uop.cond, uop.sext = X87_COM, 0, 1  # fucomp
+        else:
+            uop.opc = OPC_INVALID
+        return
+    if op == 0xDB:
+        if modbyte == 0xE2:
+            uop.sub = X87_FNCLEX
+        elif modbyte == 0xE3:
+            uop.sub = X87_FNINIT
+        elif 0xE8 <= modbyte <= 0xF7:  # fucomi / fcomi
+            uop.sub = X87_COMI
+        else:  # fcmovcc out of subset
+            uop.opc = OPC_INVALID
+        return
+    if op == 0xDF:
+        if modbyte == 0xE0:
+            uop.sub = X87_FNSTSW_AX
+        elif 0xE8 <= modbyte <= 0xF7:  # fucomip / fcomip
+            uop.sub, uop.sext = X87_COMI, 1
+        else:
+            uop.opc = OPC_INVALID
+        return
+    if op == 0xDA:
+        if modbyte == 0xE9:  # fucompp
+            uop.sub, uop.cond, uop.sext = X87_COM, 0, 2
+            return
+        uop.opc = OPC_INVALID  # fcmovcc out of subset
+        return
+    uop.opc = OPC_INVALID
+
+
 def _decode_0f_sse(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
     """XMM data movement + bitwise ops (the subset memcpy/strcmp-style code
     uses).  dst/src kind K_XMM means the register index refers to xmm0-15."""
@@ -1046,6 +1238,10 @@ def _decode_0f_sse(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
             uop.dst_kind, uop.dst_reg = K_XMM, modrm.reg
         else:
             uop.src_kind, uop.src_reg = K_XMM, modrm.reg
+
+    if op == 0x77 and not (pfx.osize or pfx.rep or pfx.repne):
+        uop.opc, uop.sub = OPC_X87, X87_EMMS  # clears the x87 tag word
+        return
 
     # movlps/movhps family (66 = movlpd/movhpd, integer-identical; the
     # F3/F2 forms movsldup/movddup are out of the subset).  sub 4 = low

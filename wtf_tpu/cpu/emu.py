@@ -115,6 +115,64 @@ class EmuMem:
         return int.from_bytes(self.phys_read(gpa, 8), "little")
 
 
+def _f80_to_f64_bits(v80: int) -> int:
+    """80-bit x87 extended -> f64 bits (round-to-nearest-even on the
+    mantissa; overflow -> inf, tiny -> 0; good enough for reducing a
+    snapshot's FPU stack into the double-precision model)."""
+    import struct as _struct
+
+    sign = (v80 >> 79) & 1
+    exp = (v80 >> 64) & 0x7FFF
+    mant = v80 & ((1 << 64) - 1)
+    if exp == 0x7FFF:  # inf / nan
+        frac = (mant >> 11) & ((1 << 52) - 1)
+        if mant & ((1 << 63) - 1):  # nan: keep top payload bits, quiet
+            frac |= 1 << 51
+        return (sign << 63) | (0x7FF << 52) | frac
+    if exp == 0 and mant == 0:
+        return sign << 63
+    # normalize (pseudo-denormals included: integer bit may be 0)
+    e = exp - 16383
+    m = mant
+    if m == 0:
+        return sign << 63
+    while not m >> 63:
+        m <<= 1
+        e -= 1
+    import math
+
+    try:
+        f = math.ldexp(m / (1 << 63), e)  # m/2^63 rounds the mantissa once
+    except OverflowError:
+        f = math.inf
+    if sign:
+        f = -f
+    return int.from_bytes(_struct.pack("<d", f), "little")
+
+
+def _f64_to_f80(bits64: int) -> int:
+    """f64 bits -> 80-bit x87 extended (exact; for the fxsave image)."""
+    sign = (bits64 >> 63) & 1
+    exp = (bits64 >> 52) & 0x7FF
+    frac = bits64 & ((1 << 52) - 1)
+    if exp == 0x7FF:  # inf / nan
+        mant = (1 << 63) | (frac << 11)
+        return (sign << 79) | (0x7FFF << 64) | mant
+    if exp == 0:
+        if frac == 0:
+            return sign << 79
+        # denormal: normalize into the explicit-integer-bit format
+        e = -1022
+        m = frac
+        while not m >> 52:
+            m <<= 1
+            e -= 1
+        return ((sign << 79) | ((e + 16383) << 64)
+                | ((m & ((1 << 52) - 1)) << 11) | (1 << 63))
+    return ((sign << 79) | ((exp - 1023 + 16383) << 64)
+            | (1 << 63) | (frac << 11))
+
+
 def _sx(value: int, bits: int) -> int:
     sign = 1 << (bits - 1)
     return ((value ^ sign) - sign)
@@ -141,6 +199,15 @@ class EmuCpu:
         self.cr8 = 0
         self.cs_sel = 0
         self.ss_sel = 0
+        # x87 state: values as f64 bits per PHYSICAL slot (see OPC_X87
+        # note in cpu/uops.py for the precision model), TOP kept separate
+        # and re-packed into fpsw bits 11-13 at observation points
+        self.fpst: List[int] = [0] * 8
+        self.fptop = 0
+        self.fpcw = 0x27F
+        self.fpsw = 0
+        self.fptw = 0xFFFF
+        self.mxcsr = 0x1F80
         self.fs_base = 0
         self.gs_base = 0
         self.kernel_gs_base = 0
@@ -171,6 +238,16 @@ class EmuCpu:
         self.cr8 = state.cr8
         self.cs_sel = state.cs.selector
         self.ss_sel = state.ss.selector
+        # snapshot fpst entries may be 80-bit extended (real dumps);
+        # reduce to the f64 model on load
+        self.fpst = [
+            (_f80_to_f64_bits(v) if v >> 64 else v & MASK64)
+            for v in state.fpst[:8]] + [0] * (8 - len(state.fpst[:8]))
+        self.fpcw = state.fpcw & 0xFFFF
+        self.fpsw = state.fpsw & 0xFFFF
+        self.fptop = (state.fpsw >> 11) & 7
+        self.fptw = state.fptw & 0xFFFF
+        self.mxcsr = state.mxcsr & 0xFFFFFFFF
         self.fs_base = state.fs.base
         self.gs_base = state.gs.base
         self.kernel_gs_base = state.kernel_gs_base
@@ -294,6 +371,13 @@ class EmuCpu:
 
     def set_cr2(self, value: int) -> None:
         self.cr2 = value & MASK64
+
+    # -- x87 state observation (lane writeback / fxsave) -----------------
+    def fp_state_list(self) -> List[int]:
+        return list(self.fpst)
+
+    def fpsw_packed(self) -> int:
+        return (self.fpsw & ~0x3800) | ((self.fptop & 7) << 11)
 
     def deliver_exception(self, vector: int, error_code: int = 0,
                           cr2=None) -> None:
@@ -761,6 +845,8 @@ class EmuCpu:
             self._exec_ssealu(uop, ea)
         elif opc == U.OPC_SSEFP:
             self._exec_ssefp(uop, ea)
+        elif opc == U.OPC_X87:
+            self._exec_x87(uop, ea)
         elif opc in (U.OPC_INT, U.OPC_HLT, U.OPC_INT1):
             raise GuestCrash(self.rip, uop)
         else:
@@ -1271,6 +1357,236 @@ class EmuCpu:
         else:
             raise UnsupportedInsn(self.rip, uop.raw)
         self._write_xmm_bytes(uop.dst_reg, out, merge=False)
+
+    # -- x87 -------------------------------------------------------------
+    def _st_phys(self, i: int) -> int:
+        return (self.fptop + i) & 7
+
+    def _st_bits(self, i: int) -> int:
+        return self.fpst[self._st_phys(i)]
+
+    def _st_f(self, i: int) -> float:
+        import struct as _s
+
+        return _s.unpack("<d", self._st_bits(i).to_bytes(8, "little"))[0]
+
+    def _st_set_f(self, i: int, value: float) -> None:
+        import struct as _s
+
+        self.fpst[self._st_phys(i)] = int.from_bytes(
+            _s.pack("<d", value), "little")
+
+    def _fp_tag(self, phys: int, empty: bool) -> None:
+        self.fptw = (self.fptw & ~(3 << (phys * 2))) | (
+            (3 if empty else 0) << (phys * 2))
+
+    def _fp_push_bits(self, bits: int) -> None:
+        self.fptop = (self.fptop - 1) & 7
+        self.fpst[self.fptop] = bits & MASK64
+        self._fp_tag(self.fptop, empty=False)
+
+    def _fp_pop(self, count: int = 1) -> None:
+        for _ in range(count):
+            self._fp_tag(self.fptop, empty=True)
+            self.fptop = (self.fptop + 1) & 7
+
+    def _exec_x87(self, uop, ea) -> None:  # noqa: C901 - one dispatcher
+        """x87 subset (OPC_X87): double-precision value model — bit-exact
+        vs hardware under the PC=53 control word Windows runs with (see
+        cpu/uops.py).  No x87 exceptions/faults are modeled beyond the
+        memory accesses themselves."""
+        import math
+        import struct as _s
+
+        sub = uop.sub
+        i = uop.imm & 7
+        if sub == U.X87_FLD_M:
+            raw = self.virt_read(ea, uop.srcsize)
+            f = _s.unpack("<f" if uop.srcsize == 4 else "<d", raw)[0]
+            self._fp_push_bits(int.from_bytes(_s.pack("<d", f), "little"))
+        elif sub == U.X87_FST_M:
+            f = self._st_f(0)
+            if uop.srcsize == 4:
+                import numpy as np
+
+                self.virt_write(ea, np.asarray(f, dtype="<f4").tobytes())
+            else:
+                self.virt_write(ea, _s.pack("<d", f))
+            if uop.sext:
+                self._fp_pop()
+        elif sub == U.X87_FILD:
+            v = _sx(self.read_u(ea, uop.srcsize), uop.srcsize * 8)
+            import numpy as np
+
+            f = float(np.asarray(v, dtype=np.int64).astype(np.float64))
+            self._fp_push_bits(int.from_bytes(_s.pack("<d", f), "little"))
+        elif sub in (U.X87_FIST, U.X87_FIST_T):
+            import numpy as np
+
+            bits = uop.srcsize * 8
+            f = self._st_f(0)
+            indefinite = 1 << (bits - 1)
+            if f != f or f in (math.inf, -math.inf):
+                r = indefinite
+            else:
+                # fisttp always chops; fist(p) honors fpcw.RC (bits 10-11:
+                # 0 nearest-even, 1 down, 2 up, 3 chop) — the classic
+                # pre-SSE truncation idiom rewrites RC around the store
+                rc = 3 if sub == U.X87_FIST_T else (self.fpcw >> 10) & 3
+                if rc == 0:
+                    r = int(np.rint(np.asarray(f)))
+                elif rc == 1:
+                    r = math.floor(f)
+                elif rc == 2:
+                    r = math.ceil(f)
+                else:
+                    r = int(f)
+                if not -(1 << (bits - 1)) <= r < (1 << (bits - 1)):
+                    r = indefinite
+            self.write_u(ea, uop.srcsize, r & ((1 << bits) - 1))
+            if uop.sext:
+                self._fp_pop()
+        elif sub == U.X87_FLD_STI:
+            self._fp_push_bits(self._st_bits(i))
+        elif sub == U.X87_FST_STI:
+            self.fpst[self._st_phys(i)] = self._st_bits(0)
+            self._fp_tag(self._st_phys(i), empty=False)
+            if uop.sext:
+                self._fp_pop()
+        elif sub == U.X87_FLD_CONST:
+            f = 1.0 if uop.imm == 0 else 0.0
+            self._fp_push_bits(int.from_bytes(_s.pack("<d", f), "little"))
+        elif sub in (U.X87_ARITH_M, U.X87_ARITH_ST):
+            if sub == U.X87_ARITH_M:
+                raw = self.virt_read(ea, uop.srcsize)
+                b = _s.unpack("<f" if uop.srcsize == 4 else "<d", raw)[0]
+                a = self._st_f(0)
+                dst = 0
+            elif uop.dst_reg:  # DC/DE: st(i) = st(i) OP st(0)
+                a, b = self._st_f(i), self._st_f(0)
+                dst = i
+            else:              # D8: st(0) = st(0) OP st(i)
+                a, b = self._st_f(0), self._st_f(i)
+                dst = 0
+            op = uop.cond
+            if op in (U.X87_OP_COM, U.X87_OP_COMP):
+                self._x87_compare(a, b, into_rflags=False)
+            else:
+                import numpy as np
+
+                an, bn = np.float64(a), np.float64(b)
+                with np.errstate(all="ignore"):  # IEEE inf/nan semantics
+                    if op == U.X87_OP_ADD:
+                        r = an + bn
+                    elif op == U.X87_OP_MUL:
+                        r = an * bn
+                    elif op == U.X87_OP_SUB:
+                        r = an - bn
+                    elif op == U.X87_OP_SUBR:
+                        r = bn - an
+                    elif op == U.X87_OP_DIV:
+                        r = an / bn
+                    else:  # X87_OP_DIVR
+                        r = bn / an
+                self._st_set_f(dst, float(r))
+            if uop.sext:
+                self._fp_pop()
+        elif sub == U.X87_FXCH:
+            pa, pb = self._st_phys(0), self._st_phys(i)
+            self.fpst[pa], self.fpst[pb] = self.fpst[pb], self.fpst[pa]
+        elif sub == U.X87_FCHS:
+            self.fpst[self._st_phys(0)] ^= 1 << 63
+        elif sub == U.X87_FABS:
+            self.fpst[self._st_phys(0)] &= ~(1 << 63)
+        elif sub == U.X87_FNSTCW:
+            self.write_u(ea, 2, self.fpcw)
+        elif sub == U.X87_FLDCW:
+            self.fpcw = self.read_u(ea, 2)
+        elif sub == U.X87_FNSTSW_AX:
+            self.write_reg(0, 2, self.fpsw_packed())
+        elif sub == U.X87_FNSTSW_M:
+            self.write_u(ea, 2, self.fpsw_packed())
+        elif sub == U.X87_COMI:
+            a, b = self._st_f(0), self._st_f(i)
+            self._x87_compare(a, b, into_rflags=True)
+            if uop.sext:
+                self._fp_pop(uop.sext)
+        elif sub == U.X87_COM:
+            a, b = self._st_f(0), self._st_f(i)
+            self._x87_compare(a, b, into_rflags=False)
+            if uop.sext:
+                self._fp_pop(uop.sext)
+        elif sub == U.X87_FNINIT:
+            self.fpcw, self.fpsw, self.fptw, self.fptop = 0x37F, 0, 0xFFFF, 0
+        elif sub == U.X87_FNCLEX:
+            self.fpsw &= ~0x80FF
+        elif sub == U.X87_FFREE:
+            self._fp_tag(self._st_phys(i), empty=True)
+        elif sub == U.X87_EMMS:
+            self.fptw = 0xFFFF
+        elif sub == U.X87_LDMXCSR:
+            self.mxcsr = self.read_u(ea, 4)
+        elif sub == U.X87_STMXCSR:
+            self.write_u(ea, 4, self.mxcsr & 0xFFFFFFFF)
+        elif sub == U.X87_FXSAVE:
+            self.virt_write(ea, self._fxsave_image())
+        elif sub == U.X87_FXRSTOR:
+            self._fxrstor_image(self.virt_read(ea, 512))
+        else:
+            raise UnsupportedInsn(self.rip, uop.raw)
+
+    def _x87_compare(self, a: float, b: float, into_rflags: bool) -> None:
+        unord = a != a or b != b
+        zf, pf, cf = (True, True, True) if unord else (
+            a == b, False, a < b)
+        if into_rflags:  # fcomi/fucomi family
+            self.set_flags(zf=zf, pf=pf, cf=cf, of=False, af=False, sf=False)
+        else:  # fcom family: C3/C2/C0 in the status word
+            self.fpsw = (self.fpsw & ~0x4500) | (
+                (0x4000 if zf else 0) | (0x400 if pf else 0)
+                | (0x100 if cf else 0))
+
+    def _fxsave_image(self) -> bytes:
+        """The 512-byte FXSAVE64 area (SDM vol 1 10.5.1): control words,
+        abridged tag, ST0-7 as 80-bit extended, XMM0-15."""
+        out = bytearray(512)
+        import struct as _s
+
+        _s.pack_into("<HH", out, 0, self.fpcw & 0xFFFF, self.fpsw_packed())
+        # abridged tag: bit i = 1 when physical reg i is NOT empty
+        abridged = 0
+        for phys in range(8):
+            if (self.fptw >> (phys * 2)) & 3 != 3:
+                abridged |= 1 << phys
+        out[4] = abridged
+        _s.pack_into("<I", out, 24, self.mxcsr & 0xFFFFFFFF)
+        _s.pack_into("<I", out, 28, 0xFFBF)  # mxcsr_mask
+        for j in range(8):
+            # slots hold st(j) (top-relative), 80-bit value + 6 pad bytes
+            v80 = _f64_to_f80(self._st_bits(j))
+            out[32 + 16 * j:32 + 16 * j + 10] = v80.to_bytes(10, "little")
+        for r in range(16):
+            out[160 + 16 * r:176 + 16 * r] = self._read_xmm_bytes(r, 16)
+        return bytes(out)
+
+    def _fxrstor_image(self, raw: bytes) -> None:
+        import struct as _s
+
+        self.fpcw, fpsw = _s.unpack_from("<HH", raw, 0)
+        self.fpsw = fpsw
+        self.fptop = (fpsw >> 11) & 7
+        abridged = raw[4]
+        self.fptw = 0
+        for phys in range(8):
+            tag = 0 if (abridged >> phys) & 1 else 3
+            self.fptw |= tag << (phys * 2)
+        (self.mxcsr,) = _s.unpack_from("<I", raw, 24)
+        for j in range(8):
+            v80 = int.from_bytes(raw[32 + 16 * j:32 + 16 * j + 10], "little")
+            self.fpst[self._st_phys(j)] = _f80_to_f64_bits(v80)
+        for r in range(16):
+            self._write_xmm_bytes(r, raw[160 + 16 * r:176 + 16 * r],
+                                  merge=False)
 
     def _exec_ssefp(self, uop, ea) -> None:
         """SSE/SSE2 floating point (OPC_SSEFP) — semantics in _SseFp."""

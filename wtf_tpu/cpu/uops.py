@@ -94,8 +94,28 @@ OPC_SSEFP = 49     # SSE/SSE2 floating point (sub FP_*; srcsize = element
                    # (tools/decode_census.py); oracle-serviced — guests in
                    # the snapshot-fuzzing domain run integer-heavy paths,
                    # so FP trapping to the host costs little
+OPC_X87 = 50       # x87 FPU subset (sub X87_*; oracle-serviced).  Values
+                   # held in double precision — Windows runs the FPU with
+                   # PC=53-bit (fpcw 0x27F), where add/sub/mul/div round
+                   # identically to f64, so the model is bit-exact for the
+                   # codegen that actually appears; 80-bit-extended
+                   # corner cases (PC=64 + huge exponents) diverge
 
-N_OPC = 50
+N_OPC = 51
+
+# OPC_X87 sub-operations.  Field conventions: srcsize = memory operand
+# width, sext = number of stack pops (0/1/2), imm = st(i) index or
+# constant id, cond = arithmetic op digit, dst_reg = 1 when st(i) is the
+# destination (DC/DE forms).
+(X87_FLD_M, X87_FST_M, X87_FILD, X87_FIST, X87_FIST_T, X87_FLD_STI,
+ X87_FST_STI, X87_FLD_CONST, X87_ARITH_M, X87_ARITH_ST, X87_FXCH,
+ X87_FCHS, X87_FABS, X87_FNSTCW, X87_FLDCW, X87_FNSTSW_AX, X87_FNSTSW_M,
+ X87_COMI, X87_COM, X87_FNINIT, X87_FNCLEX, X87_FFREE, X87_LDMXCSR,
+ X87_STMXCSR, X87_FXSAVE, X87_FXRSTOR, X87_EMMS) = range(27)
+
+# X87_ARITH_* op digits (x87 /r encoding)
+X87_OP_ADD, X87_OP_MUL, X87_OP_COM, X87_OP_COMP, X87_OP_SUB, \
+    X87_OP_SUBR, X87_OP_DIV, X87_OP_DIVR = range(8)
 
 # OPC_SSEFP sub-operations
 FP_ADD = 0

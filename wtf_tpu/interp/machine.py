@@ -51,6 +51,13 @@ class Machine(NamedTuple):
     sfmask: jax.Array     # uint64[L]
     efer: jax.Array       # uint64[L]
     tsc: jax.Array        # uint64[L]
+    # x87/SSE control state: carried (never computed on device) so the
+    # oracle's per-step fallback sees a persistent FPU across steps
+    fpst: jax.Array       # uint64[L, 8] f64 bits per physical slot
+    fpcw: jax.Array       # uint64[L]
+    fpsw: jax.Array       # uint64[L] (incl. TOP bits 11-13)
+    fptw: jax.Array       # uint64[L]
+    mxcsr: jax.Array      # uint64[L]
 
     # Run bookkeeping
     status: jax.Array     # int32[L] (core.results.StatusCode)
@@ -87,6 +94,17 @@ def cpu_vector(cpu: CpuState) -> np.ndarray:
         ],
         dtype=np.uint64,
     )
+
+
+def _fpst_f64_bits(v: int) -> int:
+    """Snapshot fpst entry -> the f64-bits FPU model: 80-bit extended
+    values (real dumps) reduce via the oracle's converter; already-64-bit
+    values pass through."""
+    if v >> 64:
+        from wtf_tpu.cpu.emu import _f80_to_f64_bits
+
+        return _f80_to_f64_bits(v)
+    return v & (1 << 64) - 1
 
 
 def machine_init(
@@ -128,6 +146,13 @@ def machine_init(
         sfmask=bcast(cpu.sfmask),
         efer=bcast(cpu.efer),
         tsc=bcast(cpu.tsc),
+        fpst=jnp.asarray(np.tile(np.array(
+            [_fpst_f64_bits(v) for v in cpu.fpst[:8]],
+            dtype=np.uint64), (n_lanes, 1))),
+        fpcw=bcast(cpu.fpcw),
+        fpsw=bcast(cpu.fpsw),
+        fptw=bcast(cpu.fptw),
+        mxcsr=bcast(cpu.mxcsr),
         status=jnp.full((n_lanes,), int(StatusCode.RUNNING), dtype=jnp.int32),
         icount=jnp.zeros((n_lanes,), dtype=jnp.uint64),
         rdrand=jnp.zeros((n_lanes,), dtype=jnp.uint64),

@@ -58,6 +58,7 @@ _MIRROR_FIELDS = (
     "gpr", "rip", "rflags", "xmm", "fs_base", "gs_base", "kernel_gs_base",
     "cr0", "cr2", "cr3", "cr4", "cr8", "cs", "ss",
     "lstar", "star", "sfmask", "efer", "tsc",
+    "fpst", "fpcw", "fpsw", "fptw", "mxcsr",
     "status", "icount", "rdrand", "bp_skip", "fault_gva", "fault_write",
 )
 
@@ -349,6 +350,11 @@ def _lane_cpu_state(view: HostView, lane: int, snapshot_cpu: CpuState) -> CpuSta
     cpu.sfmask = int(view.r["sfmask"][lane])
     cpu.efer = int(view.r["efer"][lane])
     cpu.tsc = int(view.r["tsc"][lane])
+    cpu.fpst = [int(v) for v in view.r["fpst"][lane]]
+    cpu.fpcw = int(view.r["fpcw"][lane])
+    cpu.fpsw = int(view.r["fpsw"][lane])
+    cpu.fptw = int(view.r["fptw"][lane])
+    cpu.mxcsr = int(view.r["mxcsr"][lane])
     for i in range(16):
         cpu.zmm[i][0] = int(view.r["xmm"][lane, i, 0])
         cpu.zmm[i][1] = int(view.r["xmm"][lane, i, 1])
@@ -375,6 +381,12 @@ def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
     view.r["sfmask"][lane] = np.uint64(cpu.sfmask & MASK64)
     view.r["efer"][lane] = np.uint64(cpu.efer & MASK64)
     view.r["tsc"][lane] = np.uint64(cpu.tsc & MASK64)
+    view.r["fpst"][lane] = np.array(
+        [v & MASK64 for v in cpu.fp_state_list()], dtype=np.uint64)
+    view.r["fpcw"][lane] = np.uint64(cpu.fpcw & 0xFFFF)
+    view.r["fpsw"][lane] = np.uint64(cpu.fpsw_packed() & 0xFFFF)
+    view.r["fptw"][lane] = np.uint64(cpu.fptw & 0xFFFF)
+    view.r["mxcsr"][lane] = np.uint64(cpu.mxcsr & MASK64)
     for i in range(16):
         view.r["xmm"][lane, i, 0] = np.uint64(cpu.xmm[i][0] & MASK64)
         view.r["xmm"][lane, i, 1] = np.uint64(cpu.xmm[i][1] & MASK64)
