@@ -26,9 +26,11 @@ class StatusCode(enum.IntEnum):
     CRASH = 4       # guest crashed (fault, bugcheck, harness-detected)
     BREAKPOINT = 5  # paused at a breakpoint awaiting host servicing
     UNSUPPORTED = 6 # interpreter hit an unimplemented instruction
-    PAGE_FAULT = 7  # unresolvable translation — terminal in this design
-                    # (surfaced as a memory-access crash; the reference's
-                    # #PF *injection* path is a separate host-write helper)
+    PAGE_FAULT = 7  # translation fault.  When the snapshot carries an IDT
+                    # the host delivers it through the guest kernel
+                    # (cpu/interrupts.py) and the lane resumes; otherwise
+                    # (or on delivery failure) it is terminal and surfaces
+                    # as a memory-access crash
     NEED_DECODE = 8   # rip not in the uop table; host must decode + resume
     SMC = 9           # lane's code bytes diverge from the shared decode cache
     OVERLAY_FULL = 10 # lane ran out of dirty-page overlay slots
