@@ -198,6 +198,8 @@ def test_cvt_shapes_vs_hardware(op, bits_lo, bits_hi):
     "unpckhps xmm0, xmm1", "unpcklpd xmm0, xmm1", "unpckhpd xmm0, xmm1",
     "andps xmm0, xmm1", "orps xmm0, xmm1", "andnps xmm0, xmm1",
     "andpd xmm0, xmm1", "orpd xmm0, xmm1",
+    "psllq xmm0, 3", "psrlq xmm0, 17", "psllq xmm0, 63",
+    "psrlq xmm0, 64", "psllq xmm0, 200",  # counts > 63 zero the register
 ])
 def test_shuffle_bitwise_vs_hardware(op):
     snippet = ("movq xmm0, rax\nmovq xmm2, rdx\npunpcklqdq xmm0, xmm2\n"

@@ -247,6 +247,19 @@ DIFF_CASES = [
         movdqu [rbx+32], xmm0
         movdqu [rbx+48], xmm2
         hlt""", {DATA_BASE: bytes(range(200, 232)) + b"\x00" * 0x100}),
+    ("sse_psllq_psrlq_imm", f"""
+        mov rbx, {DATA_BASE}
+        movdqu xmm0, [rbx]
+        psllq xmm0, 5
+        movdqu xmm1, [rbx]
+        psrlq xmm1, 23
+        movdqu xmm2, [rbx]
+        psrlq xmm2, 64
+        movdqu xmm3, [rbx]
+        psllq xmm3, 63
+        movdqu [rbx+32], xmm0
+        movdqu [rbx+48], xmm1
+        hlt""", {DATA_BASE: bytes(range(100, 132)) + b"\x00" * 0x100}),
     ("sse_movlps_movhps", f"""
         mov rbx, {DATA_BASE}
         movdqu xmm0, [rbx]

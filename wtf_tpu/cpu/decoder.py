@@ -52,6 +52,7 @@ from wtf_tpu.cpu.uops import (
     SSE_PCMPEQD,
     SSE_PCMPEQW, SSE_PMINUB, SSE_PMOVMSKB, SSE_PADDQ, SSE_POR, SSE_PSHUFD,
     SSE_PSLLDQ,
+    SSE_PSLLQ_I, SSE_PSRLQ_I,
     SSE_PSRLDQ, SSE_PSUBB, SSE_PTEST, SSE_PUNPCKLDQ, SSE_PUNPCKLQDQ, SSE_PXOR,
     SSE_XORPS, STR_CMPS,
     STR_LODS, STR_MOVS, STR_SCAS, STR_STOS, UN_DEC, UN_INC, UN_NEG, UN_NOT,
@@ -1519,14 +1520,15 @@ def _decode_0f_sse(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         uop.imm = cur.u8()
         return
 
-    if op == 0x73 and pfx.osize:  # group 14: pslldq/psrldq imm8
+    if op == 0x73 and pfx.osize:  # group 14: psrlq/psllq/psrldq/pslldq imm8
         modrm = _ModRM(cur, pfx)
         sub = modrm.reg & 7
-        if modrm.is_mem or sub not in (3, 7):
+        if modrm.is_mem or sub not in (2, 3, 6, 7):
             uop.opc = OPC_INVALID
             return
         uop.opc = OPC_SSEALU
-        uop.sub = SSE_PSLLDQ if sub == 7 else SSE_PSRLDQ
+        uop.sub = {2: SSE_PSRLQ_I, 3: SSE_PSRLDQ,
+                   6: SSE_PSLLQ_I, 7: SSE_PSLLDQ}[sub]
         uop.opsize = 16
         uop.dst_kind, uop.dst_reg = K_XMM, modrm.rm_reg
         uop.src_kind, uop.imm = K_IMM, cur.u8()

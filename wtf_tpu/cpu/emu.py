@@ -1354,6 +1354,18 @@ class EmuCpu:
         elif sub == U.SSE_PSRLDQ:
             n = min(uop.imm, 16)
             out = (dst[n:] + b"\x00" * 16)[:16]
+        elif sub in (U.SSE_PSLLQ_I, U.SSE_PSRLQ_I):
+            n = uop.imm
+            if n > 63:
+                out = bytes(16)
+            else:
+                lo = int.from_bytes(dst[:8], "little")
+                hi = int.from_bytes(dst[8:], "little")
+                if sub == U.SSE_PSLLQ_I:
+                    lo, hi = (lo << n) & MASK64, (hi << n) & MASK64
+                else:
+                    lo, hi = lo >> n, hi >> n
+                out = lo.to_bytes(8, "little") + hi.to_bytes(8, "little")
         else:
             raise UnsupportedInsn(self.rip, uop.raw)
         self._write_xmm_bytes(uop.dst_reg, out, merge=False)

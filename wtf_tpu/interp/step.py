@@ -947,6 +947,20 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     is_paddq = is_ssealu & (sub == U.SSE_PADDQ)
     sse_out_lo = jnp.where(is_paddq, x_dst_lo + x_src_lo, sse_out_lo)
     sse_out_hi = jnp.where(is_paddq, x_dst_hi + x_src_hi, sse_out_hi)
+    # psllq/psrlq imm: per-qword bit shifts on the limbs (count > 63
+    # architecturally zeroes the register)
+    shq = jnp.minimum(imm, _u(63))
+    shq_zero = imm > _u(63)
+    is_psllq = is_ssealu & (sub == U.SSE_PSLLQ_I)
+    is_psrlq = is_ssealu & (sub == U.SSE_PSRLQ_I)
+    sse_out_lo = jnp.where(
+        is_psllq, jnp.where(shq_zero, _u(0), x_dst_lo << shq), sse_out_lo)
+    sse_out_hi = jnp.where(
+        is_psllq, jnp.where(shq_zero, _u(0), x_dst_hi << shq), sse_out_hi)
+    sse_out_lo = jnp.where(
+        is_psrlq, jnp.where(shq_zero, _u(0), x_dst_lo >> shq), sse_out_lo)
+    sse_out_hi = jnp.where(
+        is_psrlq, jnp.where(shq_zero, _u(0), x_dst_hi >> shq), sse_out_hi)
     # pmovmskb: sign bit of each src byte -> gpr bit i
     bsrc_msk = _unpack_bytes(xmm[jnp.clip(sr, 0, 15), 0],
                              xmm[jnp.clip(sr, 0, 15), 1])
