@@ -745,6 +745,11 @@ def _decode_primary(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
     if op == 0xCD:  # int imm8
         uop.opc, uop.sub = OPC_INT, cur.u8()
         return
+    if op in (0xCA, 0xCB):  # retf [imm16]: far return (sub 1)
+        uop.opc, uop.sub = OPC_IRET, 1
+        uop.opsize = 8  # 64-bit far returns pop qword rip + qword cs
+        uop.imm = cur.u16() if op == 0xCA else 0
+        return
     if op == 0xCF:  # iret / iretq (REX.W): kernel-mode interrupt return
         uop.opc = OPC_IRET
         uop.opsize = 8 if pfx.rex_w else 4

@@ -628,6 +628,22 @@ class EmuCpu:
             self.gpr[4] = (self.gpr[4] + 8 + uop.imm) & MASK64
             return
         elif opc == U.OPC_IRET:
+            if uop.sub == 1:
+                # retf [imm16]: pop rip + cs; an inter-privilege far
+                # return also pops SS:RSP (64-bit far forms, SDM RET)
+                rsp = self.gpr[4]
+                new_rip = self.read_u(rsp, 8)
+                new_cs = self.read_u(rsp + 8, 8) & 0xFFFF
+                rsp = (rsp + 16 + uop.imm) & MASK64
+                if (new_cs & 3) != (self.cs_sel & 3):
+                    self.gpr[4] = rsp  # frame continues at adjusted rsp
+                    new_rsp = self.read_u(self.gpr[4], 8)
+                    self.ss_sel = self.read_u(self.gpr[4] + 8, 8) & 0xFFFF
+                    rsp = new_rsp & MASK64
+                self.rip = new_rip
+                self.cs_sel = new_cs
+                self.gpr[4] = rsp
+                return
             # iretq: pop rip, cs, rflags, rsp, ss (five qwords).  The
             # selectors track CPL for exception delivery (cpu/interrupts.py)
             # but are not validated against the GDT — flat memory model,
