@@ -277,3 +277,47 @@ def test_vex_three_op_degenerate_forms_decode():
     assert decode(assemble("vcvtsd2ss xmm1, xmm2, xmm3") + pad).opc \
         == OPC_INVALID
     assert decode(assemble("vpslldq xmm4, xmm5, 3") + pad).opc == OPC_INVALID
+
+
+def test_xsave_xrstor_context_switch_shape():
+    """XSAVE64/XRSTOR64 with RFBM=edx:eax — the ntoskrnl context-switch
+    idiom: save x87+SSE, clobber, restore; then a partial restore (SSE
+    only) leaves the clobbered x87 in the init state."""
+    area = 0x2000_0000
+    cpu = run_emu(
+        f"""
+        mov rbx, {area}
+        mov rax, 0x4008000000000000
+        mov [rbx+0x700], rax
+        fld qword ptr [rbx+0x700]     # st0 = 3.0
+        mov rax, 0xA1B2C3D4E5F60718
+        movq xmm9, rax
+        mov eax, 3                    # RFBM = x87|SSE
+        xor edx, edx
+        xsave [rbx]
+        fstp st(0)
+        fldz
+        fstp st(0)                    # wreck x87
+        pxor xmm9, xmm9               # wreck xmm9
+        mov eax, 3
+        xsave [rbx+0x800]             # capture the wrecked state too
+        mov eax, 3
+        xor edx, edx
+        xrstor [rbx]                  # full restore
+        fstp qword ptr [rbx+0x708]
+        mov rcx, [rbx+0x708]
+        movq rdx, xmm9
+        mov eax, 2                    # SSE-only restore from the good image
+        push rdx
+        xor edx, edx
+        xrstor [rbx]
+        pop rdx
+        fnstsw ax                     # x87 untouched by SSE-only restore
+        hlt
+        """,
+        data={area: bytes(0x1000)})
+    assert cpu.gpr[1] == 0x4008000000000000   # st0 came back as 3.0
+    assert cpu.gpr[2] == 0xA1B2C3D4E5F60718   # xmm9 came back
+    # the first XSAVE image header recorded both components
+    import struct as s
+    assert s.unpack_from("<Q", cpu.virt_read(area + 512, 8), 0)[0] == 3

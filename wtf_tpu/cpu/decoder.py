@@ -44,6 +44,7 @@ from wtf_tpu.cpu.uops import (
     X87_FLDCW, X87_FLD_CONST, X87_FLD_M, X87_FLD_STI, X87_FNCLEX,
     X87_FNINIT, X87_FNSTCW, X87_FNSTSW_AX, X87_FNSTSW_M, X87_FST_M,
     X87_FST_STI, X87_FXCH, X87_FXRSTOR, X87_FXSAVE, X87_LDMXCSR,
+    X87_XRSTOR, X87_XSAVE,
     X87_STMXCSR, X87_OP_ADD, X87_OP_COM, X87_OP_COMP, X87_OP_DIV,
     X87_OP_DIVR, X87_OP_MUL, X87_OP_SUB, X87_OP_SUBR,
     REG_AH_BASE, REG_NONE,
@@ -1002,14 +1003,15 @@ def _decode_0f(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         sub = modrm.reg & 7
         if not modrm.is_mem and sub in (5, 6, 7):  # lfence/mfence/sfence
             uop.opc = OPC_FENCE
-        elif modrm.is_mem and sub in (0, 1, 2, 3):
+        elif modrm.is_mem and sub in (0, 1, 2, 3, 4, 5):
             uop.opc = OPC_X87
             uop.sub = {0: X87_FXSAVE, 1: X87_FXRSTOR,
-                       2: X87_LDMXCSR, 3: X87_STMXCSR}[sub]
+                       2: X87_LDMXCSR, 3: X87_STMXCSR,
+                       4: X87_XSAVE, 5: X87_XRSTOR}[sub]
             _apply_mem(uop, modrm, pfx)
             uop.src_kind = K_MEM  # address carrier; width handled in exec
         else:
-            uop.opc = OPC_INVALID  # xsave/xrstor/clflush out of subset
+            uop.opc = OPC_INVALID  # clflush/clwb out of subset
         return
 
     if op == 0xAF:  # imul r, r/m

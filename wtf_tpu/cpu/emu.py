@@ -1560,6 +1560,38 @@ class EmuCpu:
             self.virt_write(ea, self._fxsave_image())
         elif sub == U.X87_FXRSTOR:
             self._fxrstor_image(self.virt_read(ea, 512))
+        elif sub == U.X87_XSAVE:
+            # XSAVE64 with RFBM = edx:eax; x87 (bit 0) + SSE (bit 1) are
+            # the components this machine model carries — the kernel
+            # context-switch path.  The legacy region is the fxsave image;
+            # XSTATE_BV in the header records what was saved.
+            rfbm = ((self.gpr[2] << 32) | (self.gpr[0] & 0xFFFFFFFF)) & 0x3
+            img = bytearray(self._fxsave_image())
+            header = bytearray(64)
+            _s.pack_into("<Q", header, 0, rfbm)  # XSTATE_BV
+            self.virt_write(ea, bytes(img) + bytes(header))
+        elif sub == U.X87_XRSTOR:
+            rfbm = ((self.gpr[2] << 32) | (self.gpr[0] & 0xFFFFFFFF)) & 0x3
+            raw = self.virt_read(ea, 576)
+            (xstate_bv,) = _s.unpack_from("<Q", raw, 512)
+            use = rfbm & xstate_bv
+            if rfbm & 1:
+                if use & 1:
+                    self._fxrstor_x87_only(raw)
+                else:  # component in init state
+                    self.fpcw, self.fpsw = 0x37F, 0
+                    self.fptw, self.fptop = 0xFFFF, 0
+                    self.fpst = [0] * 8
+            if rfbm & 2:
+                if use & 2:
+                    (self.mxcsr,) = _s.unpack_from("<I", raw, 24)
+                    for r in range(16):
+                        self._write_xmm_bytes(
+                            r, raw[160 + 16 * r:176 + 16 * r], merge=False)
+                else:
+                    self.mxcsr = 0x1F80
+                    for r in range(16):
+                        self._write_xmm_bytes(r, bytes(16), merge=False)
         else:
             raise UnsupportedInsn(self.rip, uop.raw)
 
@@ -1597,7 +1629,7 @@ class EmuCpu:
             out[160 + 16 * r:176 + 16 * r] = self._read_xmm_bytes(r, 16)
         return bytes(out)
 
-    def _fxrstor_image(self, raw: bytes) -> None:
+    def _fxrstor_x87_only(self, raw: bytes) -> None:
         import struct as _s
 
         self.fpcw, fpsw = _s.unpack_from("<HH", raw, 0)
@@ -1608,10 +1640,15 @@ class EmuCpu:
         for phys in range(8):
             tag = 0 if (abridged >> phys) & 1 else 3
             self.fptw |= tag << (phys * 2)
-        (self.mxcsr,) = _s.unpack_from("<I", raw, 24)
         for j in range(8):
             v80 = int.from_bytes(raw[32 + 16 * j:32 + 16 * j + 10], "little")
             self.fpst[self._st_phys(j)] = _f80_to_f64_bits(v80)
+
+    def _fxrstor_image(self, raw: bytes) -> None:
+        import struct as _s
+
+        self._fxrstor_x87_only(raw)
+        (self.mxcsr,) = _s.unpack_from("<I", raw, 24)
         for r in range(16):
             self._write_xmm_bytes(r, raw[160 + 16 * r:176 + 16 * r],
                                   merge=False)

@@ -111,7 +111,8 @@ N_OPC = 51
  X87_FST_STI, X87_FLD_CONST, X87_ARITH_M, X87_ARITH_ST, X87_FXCH,
  X87_FCHS, X87_FABS, X87_FNSTCW, X87_FLDCW, X87_FNSTSW_AX, X87_FNSTSW_M,
  X87_COMI, X87_COM, X87_FNINIT, X87_FNCLEX, X87_FFREE, X87_LDMXCSR,
- X87_STMXCSR, X87_FXSAVE, X87_FXRSTOR, X87_EMMS) = range(27)
+ X87_STMXCSR, X87_FXSAVE, X87_FXRSTOR, X87_EMMS,
+ X87_XSAVE, X87_XRSTOR) = range(29)
 
 # X87_ARITH_* op digits (x87 /r encoding)
 X87_OP_ADD, X87_OP_MUL, X87_OP_COM, X87_OP_COMP, X87_OP_SUB, \
