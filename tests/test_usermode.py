@@ -22,7 +22,7 @@ from wtf_tpu.harness import demo_usermode as du
 GROW4 = b"\x01\x04"          # touch 4 guard pages below rsp
 WILD_READ = b"\x02"          # read unmapped 0xDEAD0000
 DIV_ZERO = b"\x03"           # #DE via IDT gate 0
-DIV_RIP = du.USER_CODE + 89  # the `div ecx` instruction
+DIV_RIP = du.USER_CODE + 97  # the `div ecx` instruction
 
 
 def make_backend(name, **kw):
@@ -79,9 +79,23 @@ def test_stack_grows_through_faulting_push(backend_name):
         assert got == 4 - k, f"push {k}: {got}"
 
 
+NONCANON = b"\x05"          # read 0x800000000000 -> #GP via gate 13
+
+
+@pytest.mark.parametrize("backend_name", ["emu", "tpu"])
+def test_noncanonical_is_gp_not_pf(backend_name):
+    """Non-canonical accesses vector through #GP (gate 13), not #PF —
+    and surface as an A/V with NO faulting address, exactly like
+    KiGeneralProtectionFault."""
+    backend = make_backend(
+        backend_name, **({"n_lanes": 2} if backend_name == "tpu" else {}))
+    results = backend.run_batch([NONCANON], du.TARGET)
+    assert results[0].name == "crash-read-0x0", results[0]
+
+
 def test_backends_agree_and_device_stays_native():
     cases = [GROW4, WILD_READ, DIV_ZERO, b"", b"\x01\x0e", b"\x01\x00",
-             b"\x04\x05"]
+             b"\x04\x05", NONCANON]
     emu = make_backend("emu")
     tpu = make_backend("tpu", n_lanes=8)
     r_emu = emu.run_batch(cases, du.TARGET)

@@ -79,14 +79,23 @@ def has_error_code(vector: int) -> bool:
 
 
 def deliver_page_fault(ctx, gva: int, write: bool, read_translates) -> None:
-    """Compose the #PF error code and deliver vector 14 with CR2 = gva.
+    """Route a memory fault to the architecturally correct vector and
+    deliver it.
+
+    Canonical addresses take #PF (error code P/W/U, CR2 = gva); a
+    NON-canonical address is #GP(0) on real hardware — no CR2 update —
+    and Windows' KiGeneralProtectionFault turns that into an A/V with no
+    faulting address, which is exactly what harness hooks then observe.
 
     One implementation for both engines (the oracle backend and the batch
-    runner) so the error code the guest handler sees can never diverge
-    between them.  `read_translates(gva) -> bool` is the engine's probe:
-    a write that READ-translates is a protection violation (P=1), anything
-    else is non-present (P=0); U comes from the ctx's CPL.
+    runner) so what the guest handler sees can never diverge between
+    them.  `read_translates(gva) -> bool` is the engine's probe: a write
+    that READ-translates is a protection violation (P=1), anything else
+    is non-present (P=0); U comes from the ctx's CPL.
     """
+    if (gva >> 47) not in (0, 0x1FFFF):  # non-canonical: #GP, not #PF
+        deliver_exception(ctx, VEC_GP, 0)
+        return
     present = bool(write) and read_translates(gva)
     err = pf_error_code(present, write, (ctx.cs_sel & 3) == 3)
     deliver_exception(ctx, VEC_PF, err, cr2=gva)

@@ -26,6 +26,10 @@ Guest layout:
           setup_usermode_crash_detection's hook names the crash.
     cmd 3: div by zero -> #DE via IDT gate 0 -> same dispatch with
           code 0xC0000094.
+    cmd 4: grow the stack through the faulting PUSH itself.
+    cmd 5: read a NON-canonical address -> #GP (vector 13, not #PF — the
+          delivery layer routes by canonicality) -> dispatched as an A/V
+          with no faulting address, like KiGeneralProtectionFault.
   kernel @ 0xFFFF800000410000: #PF handler (gate 14) + #DE handler
           (gate 0), entered through a real 64-bit interrupt-gate IDT with
           a CPL3->0 stack switch via TSS.RSP0.
@@ -57,8 +61,8 @@ from wtf_tpu.snapshot.loader import Snapshot
 from wtf_tpu.snapshot.synthetic import SyntheticSnapshotBuilder
 
 USER_CODE = 0x0000_1500_0000
-FINISH_GVA = USER_CODE + 125        # `finish` label
-USER_DISPATCH = USER_CODE + 127     # `user_dispatch` label
+FINISH_GVA = USER_CODE + 148        # `finish` label
+USER_DISPATCH = USER_CODE + 150     # `user_dispatch` label
 USER_BUF = 0x0000_2100_0000
 XRECORD = 0x0000_2200_0000          # kernel-built EXCEPTION_RECORD64
 MAX_INPUT = 0x1000
@@ -69,7 +73,8 @@ GROW_FRAME_BASE = 0x1               # pfn of the first grown stack frame
 
 KPTWIN = 0xFFFF_8000_0040_0000      # alias of the stack-region PT page
 KERN_CODE = 0xFFFF_8000_0041_0000
-_DE_HANDLER_OFF = 170               # `de_handler` label
+_GP_HANDLER_OFF = 170               # `gp_handler` label
+_DE_HANDLER_OFF = 251               # `de_handler` label
 KSTACK_PAGE = 0xFFFF_8000_0042_0000
 KSTACK_TOP = KSTACK_PAGE + 0xF80    # TSS.RSP0
 KIDT = 0xFFFF_8000_0043_0000
@@ -83,6 +88,7 @@ user_entry:
     cmp al, 2 ; je u_wild
     cmp al, 3 ; je u_div
     cmp al, 4 ; je u_push
+    cmp al, 5 ; je u_noncanon
     jmp finish
 u_grow:
     cmp rdx, 2 ; jb finish
@@ -111,6 +117,10 @@ push_loop:
     push rcx                        # the PUSH itself faults mid-insn:
     dec rcx ; jnz push_loop         # must retry with rsp NOT yet moved
     jmp finish
+u_noncanon:
+    mov rax, 0x800000000000
+    mov rax, [rax]                  # non-canonical -> #GP via gate 13
+    jmp finish
 finish:
     nop ; hlt
 user_dispatch:                      # RtlDispatchException analog (hooked)
@@ -118,11 +128,11 @@ user_dispatch:                      # RtlDispatchException analog (hooked)
 """
 
 _USER_CODE = bytes.fromhex(
-    "4883fa017277480fb6063c01740e3c02742f3c03743a3c047443eb614883fa02"
-    "725b480fb64e014883e10f74504889e34881eb0010000048890b48ffc975f1eb"
-    "3c48b80000adde00000000488b00eb2d31d2b80100000031c9f7f1eb204883fa"
-    "02721a480fb64e014883e10f740f4881ecf80f00005148ffc975f3eb0090f490"
-    "f4"
+    "4883fa010f828a000000480fb6063c0174123c0274333c03743e3c0474473c05"
+    "7463eb704883fa02726a480fb64e014883e10f745f4889e34881eb0010000048"
+    "890b48ffc975f1eb4b48b80000adde00000000488b00eb3c31d2b80100000031"
+    "c9f7f1eb2f4883fa027229480fb64e014883e10f741e4881ecf80f00005148ff"
+    "c975f3eb0f48b80000000000800000488b00eb0090f490f4"
 )
 
 _KERN_ASM = """
@@ -156,9 +166,24 @@ seh:
     mov rax, cr2
     mov [rbx+40], rax               # info[1]: faulting VA
     mov rcx, rbx                    # rcx = &record (dispatch ABI)
-    mov rax, 0x1500007f             # USER_DISPATCH
+    mov rax, 0x15000096             # USER_DISPATCH
     mov [rsp+32], rax               # iretq frame rip -> dispatcher
     add rsp, 32                     # drop saves + error code
+    iretq
+gp_handler:                         # IDT gate 13 (#GP, error code)
+    mov rbx, 0x22000000
+    mov dword ptr [rbx], 0xC0000005 # Windows: #GP surfaces as an A/V
+    mov dword ptr [rbx+4], 0
+    mov qword ptr [rbx+8], 0
+    mov rcx, [rsp+8]                # rip (past the error code)
+    mov [rbx+16], rcx
+    mov dword ptr [rbx+24], 2
+    mov qword ptr [rbx+32], 0       # read
+    mov qword ptr [rbx+40], 0       # no faulting address for #GP
+    mov rcx, rbx
+    mov rax, 0x15000096             # USER_DISPATCH
+    mov [rsp+8], rax
+    add rsp, 8                      # drop error code
     iretq
 de_handler:                         # IDT gate 0 (no error code)
     mov rbx, 0x22000000
@@ -169,7 +194,7 @@ de_handler:                         # IDT gate 0 (no error code)
     mov [rbx+16], rcx
     mov dword ptr [rbx+24], 0
     mov rcx, rbx
-    mov rax, 0x1500007f             # USER_DISPATCH
+    mov rax, 0x15000096             # USER_DISPATCH
     mov [rsp], rax
     iretq
 """
@@ -179,10 +204,12 @@ _KERN_CODE = bytes.fromhex(
     "c348c1eb0c4881e3ff010000488d8b11feffff48c1e10c4883c90748b8000040"
     "000080ffff48890cd8595b584883c40848cf48c7c300000022c703050000c0c7"
     "43040000000048c7430800000000488b4c242048894b10c7431802000000488b"
-    "4c241848d1e94883e10148894b200f20d0488943284889d948c7c07f00001548"
-    "894424204883c42048cf48c7c300000022c703940000c0c743040000000048c7"
-    "430800000000488b0c2448894b10c74318000000004889d948c7c07f00001548"
-    "89042448cf"
+    "4c241848d1e94883e10148894b200f20d0488943284889d948c7c09600001548"
+    "894424204883c42048cf48c7c300000022c703050000c0c743040000000048c7"
+    "430800000000488b4c240848894b10c743180200000048c743200000000048c7"
+    "4328000000004889d948c7c09600001548894424084883c40848cf48c7c30000"
+    "0022c703940000c0c743040000000048c7430800000000488b0c2448894b10c7"
+    "4318000000004889d948c7c0960000154889042448cf"
 )
 
 
@@ -220,6 +247,7 @@ def build_snapshot() -> Snapshot:
 
     idt = bytearray(0x1000)
     idt[0:16] = _idt_gate(KERN_CODE + _DE_HANDLER_OFF)   # #DE
+    idt[13 * 16:14 * 16] = _idt_gate(KERN_CODE + _GP_HANDLER_OFF)  # #GP
     idt[14 * 16:15 * 16] = _idt_gate(KERN_CODE)          # #PF
     b.write(KIDT, bytes(idt))
 
