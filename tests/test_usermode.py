@@ -158,3 +158,34 @@ def test_embedded_hex_matches_sources():
 
     assert assemble(clean(du._USER_ASM)) == du._USER_CODE
     assert assemble(clean(du._KERN_ASM)) == du._KERN_CODE
+
+
+def test_delivery_soak_random_campaign():
+    """A short mangle campaign over the delivery-heavy target: thousands
+    of random inputs interleave stack growth, SEH/GP/DE dispatch, and
+    restores across lanes.  No lane may end HARD_ERROR (a delivery-loop
+    bug) and every crash must carry a dispatcher-named class."""
+    import random
+
+    from wtf_tpu.core.results import StatusCode
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+    from wtf_tpu.fuzz.mutator import ByteMutator
+
+    rng = random.Random(0x5EED5)
+    backend = make_backend("tpu", n_lanes=32)
+    corpus = Corpus(rng=rng)
+    for cmd in (1, 2, 3, 4, 5):
+        corpus.add(bytes([cmd, 3]))
+    loop = FuzzLoop(backend, du.TARGET, ByteMutator(rng, 16), corpus)
+    stats = loop.fuzz(runs=2000)
+    assert stats.testcases >= 2000
+    assert backend.runner.stats["exceptions_delivered"] > 100
+    # no lane ever parked HARD_ERROR (lane_errors holds only soft notes
+    # like double-fault downgrades, never servicing failures)
+    statuses = backend.runner.statuses()
+    assert int((statuses == int(StatusCode.HARD_ERROR)).sum()) == 0
+    for name in loop.crash_names:
+        assert name.startswith(("crash-read-", "crash-write-",
+                                "crash-execute-", "crash-divide-by-zero-",
+                                "crash-av", "crash-int-")), name

@@ -42,6 +42,9 @@ class StatusCode(enum.IntEnum):
 
 # Statuses the device can set that the host run loop must service before the
 # lane can make further progress (vs. terminal testcase outcomes).
+# PAGE_FAULT/DIVIDE_ERROR are conditionally serviceable on top of these:
+# with guest exception delivery enabled they resume through the IDT
+# (interp/runner.py), otherwise they are terminal.
 SERVICEABLE = (
     StatusCode.NEED_DECODE,
     StatusCode.BREAKPOINT,

@@ -81,21 +81,6 @@ class Machine(NamedTuple):
         return self.rip.shape[0]
 
 
-def cpu_vector(cpu: CpuState) -> np.ndarray:
-    """Flatten the device-resident scalar registers of a CpuState in the
-    order machine_init broadcasts them (host-side helper for lane reload)."""
-    return np.array(
-        cpu.gpr_list()
-        + [
-            cpu.rip, cpu.rflags | 0x2, cpu.fs.base, cpu.gs.base,
-            cpu.kernel_gs_base, cpu.cr0, cpu.cr2, cpu.cr3, cpu.cr4,
-            cpu.cr8, cpu.cs.selector, cpu.ss.selector,
-            cpu.lstar, cpu.star, cpu.sfmask, cpu.efer, cpu.tsc,
-        ],
-        dtype=np.uint64,
-    )
-
-
 def _fpst_f64_bits(v: int) -> int:
     """Snapshot fpst entry -> the f64-bits FPU model: 80-bit extended
     values (real dumps) reduce via the oracle's converter; already-64-bit
