@@ -495,7 +495,8 @@ class Runner:
         self._chunk_level = 0
         # run statistics (reference PrintRunStats role, backend.h:218)
         self.stats = {
-            "chunks": 0, "decodes": 0, "fallbacks": 0, "smc_updates": 0,
+            "chunks": 0, "decodes": 0, "decodes_prefetched": 0,
+            "fallbacks": 0, "smc_updates": 0,
             "bp_dispatches": 0, "exceptions_delivered": 0,
         }
 
@@ -550,7 +551,63 @@ class Runner:
             pfn1 = pfn0
         self.cache.add(rip, uop, pfn0, pfn1)
         self.stats["decodes"] += 1
+        self._prefetch_block(view, lane, uop, rip)
         return True
+
+    # Decode-ahead bounds: block prefetch publishes up to this many extra
+    # instructions per miss, and never within this margin of cache capacity
+    PREFETCH_BUDGET = 48
+    _PREFETCH_MARGIN = 64
+
+    def _prefetch_block(self, view: HostView, lane: int, uop, rip: int) -> None:
+        """Recursive-descent decode-ahead from a fresh miss: follow the
+        fallthrough and direct branch targets so a basic block's worth of
+        code publishes in ONE servicing round instead of one full
+        pull/push/dispatch round trip per instruction — the dominant
+        cold-start cost when the chip sits behind a tunnel (PERF.md's
+        host<->device term).  Wrong-path prefetches are harmless: decode
+        is deterministic on bytes, entries are only consulted at executed
+        rips, and OPC_INVALID results are simply not published."""
+        def succs(u, at):
+            nxt = (at + u.length) & MASK64
+            opc = u.opc
+            if opc in (U.OPC_RET, U.OPC_IRET, U.OPC_HLT, U.OPC_INT,
+                       U.OPC_INT1, U.OPC_INVALID, U.OPC_SYSCALL):
+                return ()
+            if opc == U.OPC_JMP:
+                return ((nxt + u.imm) & MASK64,) if u.src_kind == U.K_IMM \
+                    else ()
+            if opc == U.OPC_JCC:
+                return (nxt, (nxt + u.imm) & MASK64)
+            if opc == U.OPC_CALL and u.src_kind == U.K_IMM:
+                return (nxt, (nxt + u.imm) & MASK64)
+            return (nxt,)
+
+        budget = self.PREFETCH_BUDGET
+        work = list(succs(uop, rip))
+        while work and budget > 0:
+            if self.cache.count >= self.cache.capacity - self._PREFETCH_MARGIN:
+                return
+            at = work.pop()
+            if at in self.cache.index:
+                continue
+            try:
+                window = view.virt_read(lane, at, 15)
+                pfn0 = view.translate(lane, at) >> PAGE_SHIFT
+            except HostFault:
+                continue
+            u2 = decode(window, at)
+            if u2.opc == U.OPC_INVALID:
+                continue  # probably swept into data; let a real miss decide
+            try:
+                pfn1 = view.translate(
+                    lane, at + max(u2.length - 1, 0)) >> PAGE_SHIFT
+            except HostFault:
+                pfn1 = pfn0
+            self.cache.add(at, u2, pfn0, pfn1)
+            self.stats["decodes_prefetched"] += 1
+            budget -= 1
+            work.extend(succs(u2, at))
 
     def _service_decode(self, view: HostView, lanes: List[int]) -> None:
         done: Set[int] = set()
