@@ -287,3 +287,34 @@ def test_master_resume_replays_outputs(tmp_path):
     # entries are lazily-read Paths, biggest first, content-deduped
     assert [server._next_seed(), server._next_seed(), server._next_seed()] \
         == [b"BBBBBBBB", b"AAAA", None]
+
+
+def test_malformed_result_frame_drops_node_not_master(tmp_path):
+    """A desynced/garbage result frame must drop that connection (and
+    requeue its in-flight work), never crash the reactor."""
+    import socket as socketlib
+
+    rng = random.Random(5)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 16),
+                    Corpus(rng=rng), runs=0)
+    server.paths = [BENIGN, tlv((2, b"ABCDEFGH"))]
+    thread = _serve(server, seconds=60)
+    # a broken node: hello, take a testcase, answer with garbage
+    sock = wire.dial(_addr(tmp_path), retry_for=10.0)
+    wire.send_msg(sock, wire.encode_hello(1))
+    assert wire.recv_msg(sock) is not None
+    # an honest node runs concurrently (keeps the campaign alive) and
+    # finishes everything, incl. the work requeued off the broken node
+    backend = create_backend("emu", demo_tlv.build_snapshot())
+    backend.initialize()
+    client = Client(backend, demo_tlv.TARGET, _addr(tmp_path))
+    t_client = threading.Thread(target=client.run)
+    t_client.start()
+    wire.send_msg(sock, b"\xFF" * 7)  # not a decodable result body
+    t_client.join(timeout=60)
+    assert not t_client.is_alive(), "honest client hung"
+    thread.join(timeout=60)
+    sock.close()
+    assert not thread.is_alive()
+    assert client.runs == 2            # both seeds got honest executions
+    assert server.stats.testcases == 2

@@ -22,6 +22,7 @@ from __future__ import annotations
 import hashlib
 import selectors
 import socket
+import struct
 import time
 from pathlib import Path
 from typing import Dict, List, Optional, Set
@@ -323,11 +324,17 @@ class Server:
             if not conn.inflight:
                 self._set_writable(sock, True)  # greeted: open for work
             return
-        if conn.mux:
-            for result_body in wire.decode_batch(body):
-                self.handle_result(result_body)
-        else:
-            self.handle_result(body)
+        try:
+            if conn.mux:
+                for result_body in wire.decode_batch(body):
+                    self.handle_result(result_body)
+            else:
+                self.handle_result(body)
+        except (ValueError, IndexError, struct.error):
+            # desynced/malformed result frame: a broken node must not
+            # take the master down — drop it, requeue its in-flight work
+            self._drop(sock)
+            return
         conn.inflight = []
         self._set_writable(sock, True)
 
