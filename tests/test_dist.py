@@ -290,31 +290,74 @@ def test_master_resume_replays_outputs(tmp_path):
 
 
 def test_malformed_result_frame_drops_node_not_master(tmp_path):
-    """A desynced/garbage result frame must drop that connection (and
-    requeue its in-flight work), never crash the reactor."""
-    import socket as socketlib
-
+    """A desynced/garbage result frame must drop that connection and
+    requeue its in-flight work — never crash the reactor, never count
+    anything from the bad frame."""
     rng = random.Random(5)
     server = Server(_addr(tmp_path), TlvStructureMutator(rng, 16),
                     Corpus(rng=rng), runs=0)
-    server.paths = [BENIGN, tlv((2, b"ABCDEFGH"))]
+    server.paths = [BENIGN]
     thread = _serve(server, seconds=60)
-    # a broken node: hello, take a testcase, answer with garbage
+    # a broken node: hello, take the testcase, answer with garbage
     sock = wire.dial(_addr(tmp_path), retry_for=10.0)
     wire.send_msg(sock, wire.encode_hello(1))
     assert wire.recv_msg(sock) is not None
-    # an honest node runs concurrently (keeps the campaign alive) and
-    # finishes everything, incl. the work requeued off the broken node
-    backend = create_backend("emu", demo_tlv.build_snapshot())
-    backend.initialize()
-    client = Client(backend, demo_tlv.TARGET, _addr(tmp_path))
-    t_client = threading.Thread(target=client.run)
-    t_client.start()
     wire.send_msg(sock, b"\xFF" * 7)  # not a decodable result body
-    t_client.join(timeout=60)
-    assert not t_client.is_alive(), "honest client hung"
+    thread.join(timeout=60)           # reactor exits CLEANLY, not by crash
+    sock.close()
+    assert not thread.is_alive()
+    assert server.stats.testcases == 0     # nothing counted from garbage
+    assert server.paths == [BENIGN]        # in-flight work requeued
+
+
+def test_partial_mux_batch_is_all_or_nothing(tmp_path):
+    """A mux reply whose tail is garbage must account NOTHING from that
+    frame (decode-everything-first) and requeue the WHOLE in-flight set —
+    otherwise the already-counted half would execute twice elsewhere."""
+    from wtf_tpu.core.results import Ok as OkR
+
+    rng = random.Random(11)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 16),
+                    Corpus(rng=rng), runs=0)
+    seeds = [BENIGN, tlv((2, b"ABCDEFGH"))]
+    server.paths = list(seeds)
+    thread = _serve(server, seconds=60)
+    sock = wire.dial(_addr(tmp_path), retry_for=10.0)
+    wire.send_msg(sock, wire.encode_hello(2))  # mux node, 2 slots
+    got = wire.decode_batch(wire.recv_msg(sock))
+    assert sorted(got) == sorted(seeds)
+    # one VALID result + one garbage blob in the same batch frame
+    valid = wire.encode_result(got[0], {0x1400001000}, OkR())
+    wire.send_msg(sock, wire.encode_batch([valid, b"\x00"]))
     thread.join(timeout=60)
     sock.close()
     assert not thread.is_alive()
-    assert client.runs == 2            # both seeds got honest executions
-    assert server.stats.testcases == 2
+    # nothing from the poisoned frame was accounted, and BOTH testcases
+    # went back on the queue for an honest execution
+    assert server.stats.testcases == 0
+    assert len(server.coverage) == 0
+    assert sorted(server.paths) == sorted(seeds)
+
+
+def test_wire_crash_name_is_sanitized(tmp_path):
+    """A hostile node cannot steer the crash-save path: separators and
+    leading dots in the wire-supplied name are neutralized and the file
+    lands inside crashes/."""
+    rng = random.Random(12)
+    crashes = tmp_path / "crashes"
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 16),
+                    Corpus(rng=rng), crashes_dir=crashes, runs=0)
+    server.paths = [BENIGN]
+    thread = _serve(server, seconds=60)
+    sock = wire.dial(_addr(tmp_path), retry_for=10.0)
+    wire.send_msg(sock, wire.encode_hello(1))
+    tc = wire.recv_msg(sock)
+    evil = Crash("../../outside/evil")
+    wire.send_msg(sock, wire.encode_result(tc, set(), evil))
+    sock.close()
+    thread.join(timeout=60)
+    assert not thread.is_alive()
+    assert not (tmp_path / "outside").exists()
+    saved = [p.name for p in crashes.iterdir()]
+    assert saved and all("/" not in n and not n.startswith(".")
+                         for n in saved), saved

@@ -167,7 +167,9 @@ class Server:
 
     # -- result handling (server.h:785-886) --------------------------------
     def handle_result(self, body: bytes) -> None:
-        testcase, coverage, result = wire.decode_result(body)
+        self._account_result(*wire.decode_result(body))
+
+    def _account_result(self, testcase, coverage, result) -> None:
         self.stats.testcases += 1
         new = coverage - self.coverage
         if new:
@@ -178,9 +180,16 @@ class Server:
         if isinstance(result, Crash):
             self.stats.crashes += 1
             if result.name:
-                self.crash_names.add(result.name)
+                # the name crossed the WIRE: sanitize before using it as a
+                # filename (a hostile node must not steer the write path)
+                name = result.name.replace("/", "_").replace(
+                    "\\", "_").lstrip(".")[:200] or "crash-unnamed"
+                self.crash_names.add(name)
                 if self.crashes_dir:
-                    (self.crashes_dir / result.name).write_bytes(testcase)
+                    try:
+                        (self.crashes_dir / name).write_bytes(testcase)
+                    except OSError as e:
+                        print(f"crash save failed for {name!r}: {e}")
         elif isinstance(result, Timedout):
             self.stats.timeouts += 1
         elif isinstance(result, Cr3Change):
@@ -325,16 +334,25 @@ class Server:
                 self._set_writable(sock, True)  # greeted: open for work
             return
         try:
+            # decode EVERYTHING before accounting ANYTHING: a malformed
+            # tail in a mux batch must not leave already-counted results
+            # that then get requeued (double execution, stat skew)
             if conn.mux:
-                for result_body in wire.decode_batch(body):
-                    self.handle_result(result_body)
+                decoded = [wire.decode_result(b)
+                           for b in wire.decode_batch(body)]
             else:
-                self.handle_result(body)
-        except (ValueError, IndexError, struct.error):
+                decoded = [wire.decode_result(body)]
+        except (ValueError, IndexError, struct.error) as e:
             # desynced/malformed result frame: a broken node must not
-            # take the master down — drop it, requeue its in-flight work
+            # take the master down — drop it, requeue its in-flight work.
+            # Loudly: if every node trips this, the fleet has a wire
+            # mismatch and the operator needs to see it.
+            print(f"dropping node (malformed result frame: {e!r}); "
+                  f"requeueing {len(conn.inflight)} in-flight testcase(s)")
             self._drop(sock)
             return
+        for item in decoded:
+            self._account_result(*item)
         conn.inflight = []
         self._set_writable(sock, True)
 
