@@ -47,12 +47,55 @@ def measure(name, cfg, chunk=512):
     m2.status.block_until_ready()
     dt = time.time() - t0
     instr = int((np.asarray(m2.icount) - ic0).sum())
+    import jax
+
     print(json.dumps({
         "config": name, **cfg, "chunk": chunk,
+        "platform": jax.devices()[0].platform,
         "compile_s": round(compile_s, 1),
         "chunk_wall_s": round(dt, 4),
         "per_step_ms": round(dt / chunk * 1e3, 3),
         "instr_per_s": round(instr / dt, 1),
+    }), flush=True)
+
+
+def measure_deep(n_lanes=1024, limit=10_000_000, seconds=30.0):
+    """BASELINE-config-3-shaped end-to-end number (the same workload
+    bench.py reports in its `deep` extras): mangle campaign on demo_spin
+    with a 10M-instruction budget; prints execs/s + instr/s."""
+    import random
+    import struct
+
+    import jax
+
+    from wtf_tpu.backend import create_backend
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+    from wtf_tpu.fuzz.native_mutator import best_mangle_mutator
+    from wtf_tpu.harness import demo_spin
+
+    backend = create_backend("tpu", demo_spin.build_snapshot(),
+                             n_lanes=n_lanes, limit=limit, chunk_steps=512,
+                             overlay_slots=16)
+    backend.initialize()
+    demo_spin.TARGET.init(backend)
+    rng = random.Random(0xD33B)
+    corpus = Corpus(rng=rng)
+    corpus.add(struct.pack("<I", min(limit // demo_spin.INSNS_PER_ITER,
+                                     0xFFFF_FFFF)))
+    loop = FuzzLoop(backend, demo_spin.TARGET,
+                    best_mangle_mutator(rng, max_len=4), corpus)
+    loop.run_one_batch()  # warmup
+    i0, c0 = backend.stats["instructions"], loop.stats.testcases
+    t0 = time.time()
+    while time.time() - t0 < seconds:
+        loop.run_one_batch()
+    dt = time.time() - t0
+    print(json.dumps({
+        "config": "deep", "n_lanes": n_lanes, "limit": limit,
+        "platform": jax.devices()[0].platform,
+        "execs_per_s": round((loop.stats.testcases - c0) / dt, 2),
+        "instr_per_s": round((backend.stats["instructions"] - i0) / dt, 1),
     }), flush=True)
 
 
@@ -61,9 +104,12 @@ if __name__ == "__main__":
 
     faulthandler.dump_traceback_later(
         int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
-    names = sys.argv[1:] or list(CONFIGS)
+    names = sys.argv[1:] or list(CONFIGS) + ["deep"]
     for n in names:
-        measure(n, CONFIGS[n])
+        if n == "deep":
+            measure_deep()
+        else:
+            measure(n, CONFIGS[n])
         faulthandler.cancel_dump_traceback_later()
         faulthandler.dump_traceback_later(
             int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")),

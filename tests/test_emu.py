@@ -657,3 +657,30 @@ def test_retf_same_and_inter_privilege():
     assert cpu.cs_sel == 0x10
     assert cpu.ss_sel == 0x2B
     assert cpu.gpr[1] == 0x7FFDF000  # rsp came from the far frame
+
+
+def test_enter_leave_roundtrip():
+    """enter size,0 (oracle-serviced) pairs with leave; nested-level forms
+    stay INVALID."""
+    from tests.asmhelper import assemble as _asm
+    from wtf_tpu.cpu.uops import OPC_INVALID, OPC_LEAVE
+
+    assert decode(_asm("enter 0x20, 0") + b"\x90" * 8).opc == OPC_LEAVE
+    assert decode(_asm("enter 0x20, 0") + b"\x90" * 8).sub == 1
+    assert decode(_asm("enter 0x20, 3") + b"\x90" * 8).opc == OPC_INVALID
+    cpu = run_emu("""
+        mov rbp, 0x1122334455667788
+        mov rdi, rsp
+        enter 0x40, 0
+        mov rax, rbp              # frame pointer = rsp after the push
+        mov rbx, [rbp]            # saved old rbp
+        lea rcx, [rbp-0x40]       # allocation
+        leave
+        mov rdx, rsp              # balanced again
+        hlt
+    """)
+    assert cpu.gpr[0] == cpu.gpr[7] - 8          # rbp = old rsp - 8
+    assert cpu.gpr[3] == 0x1122334455667788      # old rbp was pushed
+    assert cpu.gpr[1] == cpu.gpr[0] - 0x40       # the 0x40 allocation
+    assert cpu.gpr[2] == cpu.gpr[7]              # leave rebalanced rsp
+    assert cpu.gpr[5] == 0x1122334455667788      # leave restored rbp

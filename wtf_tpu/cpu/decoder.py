@@ -736,6 +736,16 @@ def _decode_primary(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
             _imm_for(uop, cur, opsize)
         return
 
+    if op == 0xC8:  # enter imm16, imm8 — level 0 only (nested frames are
+        # a pre-386 idiom no 64-bit compiler emits); sub 1, oracle-serviced
+        size = cur.u16()
+        level = cur.u8()
+        if level != 0:
+            uop.opc = OPC_INVALID
+            return
+        uop.opc, uop.sub, uop.opsize = OPC_LEAVE, 1, 8
+        uop.imm = size
+        return
     if op == 0xC9:
         uop.opc, uop.opsize = OPC_LEAVE, 8
         return

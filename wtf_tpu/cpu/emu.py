@@ -724,9 +724,15 @@ class EmuCpu:
             self.write_reg(uop.src_reg, opsize, dst)
             store_dst(r)
         elif opc == U.OPC_LEAVE:
-            self.gpr[4] = self.gpr[5]
-            self.gpr[5] = self.read_u(self.gpr[4], 8)
-            self.gpr[4] = (self.gpr[4] + 8) & MASK64
+            if uop.sub == 1:  # enter size, 0: push rbp; rbp = rsp; alloc
+                new_rsp = (self.gpr[4] - 8) & MASK64
+                self.write_u(new_rsp, 8, self.gpr[5])  # may fault: rsp last
+                self.gpr[5] = new_rsp
+                self.gpr[4] = (new_rsp - uop.imm) & MASK64
+            else:
+                self.gpr[4] = self.gpr[5]
+                self.gpr[5] = self.read_u(self.gpr[4], 8)
+                self.gpr[4] = (self.gpr[4] + 8) & MASK64
         elif opc == U.OPC_RDTSC:
             tsc = (self.tsc + self.icount) & MASK64
             self.write_reg(0, 8, tsc & 0xFFFFFFFF)

@@ -404,6 +404,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
         | is_(U.OPC_STACKSTR) | is_(U.OPC_VZEROALL) | is_(U.OPC_SSEFP)
         | is_(U.OPC_X87)
+        | (is_(U.OPC_LEAVE) & (sub == 1))  # enter: oracle-serviced
         | (is_(U.OPC_RDGSBASE) & (sub != 4))
         # 67h string forms use 32-bit rsi/rdi/rcx; neither engine models
         # that — surface loudly instead of executing with 64-bit regs
