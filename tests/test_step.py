@@ -247,6 +247,16 @@ DIFF_CASES = [
         movdqu [rbx+32], xmm0
         movdqu [rbx+48], xmm2
         hlt""", {DATA_BASE: bytes(range(200, 232)) + b"\x00" * 0x100}),
+    ("sse_pinsrw_pextrw", f"""
+        mov rbx, {DATA_BASE}
+        movdqu xmm0, [rbx]
+        mov eax, 0xBEEF
+        pinsrw xmm0, eax, 3
+        pinsrw xmm0, eax, 7
+        pextrw ecx, xmm0, 3
+        pextrw edx, xmm0, 0
+        movdqu [rbx+32], xmm0
+        hlt""", {DATA_BASE: bytes(range(64)) + b"\x00" * 0x100}),
     ("sse_psllq_psrlq_imm", f"""
         mov rbx, {DATA_BASE}
         movdqu xmm0, [rbx]

@@ -53,7 +53,7 @@ from wtf_tpu.cpu.uops import (
     SSE_PCMPEQD,
     SSE_PCMPEQW, SSE_PMINUB, SSE_PMOVMSKB, SSE_PADDQ, SSE_POR, SSE_PSHUFD,
     SSE_PSLLDQ,
-    SSE_PSLLQ_I, SSE_PSRLQ_I,
+    SSE_PSLLQ_I, SSE_PSRLQ_I, SSE_PINSRW, SSE_PEXTRW,
     SSE_PSRLDQ, SSE_PSUBB, SSE_PTEST, SSE_PUNPCKLDQ, SSE_PUNPCKLQDQ, SSE_PXOR,
     SSE_XORPS, STR_CMPS,
     STR_LODS, STR_MOVS, STR_SCAS, STR_STOS, UN_DEC, UN_INC, UN_NEG, UN_NOT,
@@ -1515,6 +1515,31 @@ def _decode_0f_sse(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         modrm = _ModRM(cur, pfx)
         xmm_reg(modrm, is_dst=True)
         xmm_rm(modrm, is_dst=False)
+        return
+
+    if op == 0xC4 and pfx.osize:  # pinsrw xmm, r32/m16, imm8
+        uop.opc, uop.sub = OPC_SSEALU, SSE_PINSRW
+        uop.opsize = 16
+        modrm = _ModRM(cur, pfx)
+        uop.dst_kind, uop.dst_reg = K_XMM, modrm.reg
+        if modrm.is_mem:
+            _apply_mem(uop, modrm, pfx)
+            uop.src_kind = K_MEM
+            uop.srcsize = 2
+        else:
+            uop.src_kind, uop.src_reg = K_REG, modrm.rm_reg
+        uop.cond = cur.u8() & 7  # word index rides in cond (imm is data)
+        return
+    if op == 0xC5 and pfx.osize:  # pextrw r32, xmm, imm8
+        modrm = _ModRM(cur, pfx)
+        if modrm.is_mem:
+            uop.opc = OPC_INVALID  # mem form is SSE4.1 (0F 3A 15)
+            return
+        uop.opc, uop.sub = OPC_SSEALU, SSE_PEXTRW
+        uop.opsize = 4
+        uop.dst_kind, uop.dst_reg = K_REG, modrm.reg
+        uop.src_kind, uop.src_reg = K_XMM, modrm.rm_reg
+        uop.cond = cur.u8() & 7
         return
 
     if op == 0xD7:  # pmovmskb r, xmm

@@ -1307,6 +1307,23 @@ class EmuCpu:
 
     def _exec_ssealu(self, uop, ea) -> None:
         sub = uop.sub
+        if sub == U.SSE_PINSRW:
+            # word-granular insert: source is a gpr low word or an m16
+            # (only 2 bytes read — a 16-byte load could fault at page end)
+            if uop.src_kind == U.K_REG:
+                word = self.read_reg(uop.src_reg, 2)
+            else:
+                word = self.read_u(ea, 2)
+            dst = bytearray(self._read_xmm_bytes(uop.dst_reg, 16))
+            dst[uop.cond * 2:uop.cond * 2 + 2] = word.to_bytes(2, "little")
+            self._write_xmm_bytes(uop.dst_reg, bytes(dst), merge=False)
+            return
+        if sub == U.SSE_PEXTRW:
+            src = self._read_xmm_bytes(uop.src_reg, 16)
+            word = int.from_bytes(src[uop.cond * 2:uop.cond * 2 + 2],
+                                  "little")
+            self.write_reg(uop.dst_reg, 4, word)  # zero-extended to 32/64
+            return
         if uop.src_kind == U.K_XMM:
             src = self._read_xmm_bytes(uop.src_reg, 16)
         elif uop.src_kind == U.K_MEM:

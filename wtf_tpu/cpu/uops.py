@@ -188,7 +188,7 @@ FL_CLC, FL_STC, FL_CMC, FL_CLD, FL_STD, FL_CLI, FL_STI, FL_SAHF, FL_LAHF = range
 SSE_PXOR, SSE_POR, SSE_PAND, SSE_PANDN, SSE_XORPS, SSE_PCMPEQB, SSE_PMOVMSKB, \
     SSE_PSUBB, SSE_PADDB, SSE_PUNPCKLQDQ, SSE_PCMPEQW, SSE_PCMPEQD, SSE_PTEST, \
     SSE_PSHUFD, SSE_PSLLDQ, SSE_PSRLDQ, SSE_PMINUB, SSE_PUNPCKLDQ, \
-    SSE_PADDQ, SSE_PSLLQ_I, SSE_PSRLQ_I = range(21)
+    SSE_PADDQ, SSE_PSLLQ_I, SSE_PSRLQ_I, SSE_PINSRW, SSE_PEXTRW = range(23)
 
 # BMI sub-ops
 BMI_ANDN, BMI_BZHI, BMI_PEXT_, BMI_PDEP, BMI_BLSR, BMI_BLSMSK, BMI_BLSI, \

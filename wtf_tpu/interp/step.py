@@ -405,6 +405,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         | is_(U.OPC_STACKSTR) | is_(U.OPC_VZEROALL) | is_(U.OPC_SSEFP)
         | is_(U.OPC_X87)
         | (is_(U.OPC_LEAVE) & (sub == 1))  # enter: oracle-serviced
+        # pinsrw m16: a 2-byte load outside the 16-byte operand window
+        | (is_(U.OPC_SSEALU) & (sub == U.SSE_PINSRW) & (sk == U.K_MEM))
         | (is_(U.OPC_RDGSBASE) & (sub != 4))
         # 67h string forms use 32-bit rsi/rdi/rcx; neither engine models
         # that — surface loudly instead of executing with 64-bit regs
@@ -924,6 +926,11 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     # punpckldq: interleave the low dwords -> [a0 b0 a1 b1] (dword units)
     punp_src_b = (i16u // 4) & 1  # odd dword slots come from src
     punp_idx = ((i16u // 8) * 4) + (i16u % 4)
+    # pinsrw: word `cond` replaced by the gpr's low word (mem form is
+    # oracle-serviced: its 2-byte load doesn't fit the 16-byte window)
+    pinsrw_word = _read_reg(gpr, sr, jnp.int32(2))
+    pinsrw_byte = jnp.where(i16u % 2 == 0, pinsrw_word & _u(0xFF),
+                            (pinsrw_word >> _u(8)) & _u(0xFF)).astype(jnp.uint8)
     sse_bytes = jnp.select(
         [sub == U.SSE_PXOR, sub == U.SSE_XORPS, sub == U.SSE_POR,
          sub == U.SSE_PAND, sub == U.SSE_PANDN,
@@ -931,7 +938,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
          sub == U.SSE_PSUBB, sub == U.SSE_PADDB, sub == U.SSE_PMINUB,
          sub == U.SSE_PUNPCKLQDQ, sub == U.SSE_PSHUFD,
          sub == U.SSE_PSLLDQ, sub == U.SSE_PSRLDQ,
-         sub == U.SSE_PUNPCKLDQ],
+         sub == U.SSE_PUNPCKLDQ, sub == U.SSE_PINSRW],
         [ba ^ bb, ba ^ bb, ba | bb, ba & bb, (~ba) & bb,
          jnp.where(eq_b, jnp.uint8(0xFF), jnp.uint8(0)),
          jnp.where(eq_w16, jnp.uint8(0xFF), jnp.uint8(0)),
@@ -941,7 +948,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
          bb[pshufd_idx],
          jnp.where(i16u >= pslldq_n, ba[psll_idx], jnp.uint8(0)),
          jnp.where(i16u + pslldq_n < 16, ba[psrl_idx], jnp.uint8(0)),
-         jnp.where(punp_src_b == 0, ba[punp_idx], bb[punp_idx])],
+         jnp.where(punp_src_b == 0, ba[punp_idx], bb[punp_idx]),
+         jnp.where(i16u // 2 == cond, pinsrw_byte, ba)],
         default=ba)
     sse_out_lo, sse_out_hi = _pack_pair(sse_bytes)
     # paddq works on the u64 limbs directly (byte-wise adds lose carries)
@@ -968,6 +976,11 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     pmov_mask = jnp.sum(
         jnp.where((bsrc_msk & jnp.uint8(0x80)) != 0,
                   _u(1) << i16u.astype(jnp.uint64), _u(0)))
+    # pextrw: word `cond` of the src register, zero-extended into the gpr
+    pextrw_val = (jnp.where(cond < 4,
+                            xmm[jnp.clip(sr, 0, 15), 0],
+                            xmm[jnp.clip(sr, 0, 15), 1])
+                  >> ((cond & 3).astype(jnp.uint64) * _u(16))) & _u(0xFFFF)
     # ptest
     ptest_zf = ((x_dst_lo & x_src_lo) == _u(0)) & ((x_dst_hi & x_src_hi) == _u(0))
     ptest_cf = (((~x_dst_lo) & x_src_lo) == _u(0)) & (((~x_dst_hi) & x_src_hi) == _u(0))
@@ -1010,7 +1023,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_MOVCR), ~movcr_is_write),
         (is_(U.OPC_XCHG), dk == U.K_REG),
         (is_ssemov, (sub == 2) & (dk == U.K_REG)),
-        (is_ssealu, sub == U.SSE_PMOVMSKB),
+        (is_ssealu, (sub == U.SSE_PMOVMSKB) | (sub == U.SSE_PEXTRW)),
     ], jnp.bool_(False))
     w1_idx = opc_list([
         (is_mul, jnp.where(is_mul2, dr, i0)),
@@ -1050,7 +1063,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_MOVCR), cr_read),
         (is_(U.OPC_XCHG), src_val),
         (is_ssemov, xmm[jnp.clip(sr, 0, 15), 0]),
-        (is_ssealu, pmov_mask),
+        (is_ssealu, jnp.where(sub == U.SSE_PEXTRW, pextrw_val, pmov_mask)),
     ], _u(0))
     w1_size = opc_list([
         (is_mul, jnp.where(is_mul2, opsize,
@@ -1228,7 +1241,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     # -- xmm ---------------------------------------------------------------
     wx_cond = commit & (
         (is_ssemov & (sub != 2) & (dk == U.K_XMM))
-        | (is_ssealu & (sub != U.SSE_PMOVMSKB) & (sub != U.SSE_PTEST)))
+        | (is_ssealu & (sub != U.SSE_PMOVMSKB) & (sub != U.SSE_PTEST)
+           & (sub != U.SSE_PEXTRW)))
     wx_lo = jnp.where(is_ssealu, sse_out_lo, ssm_lo)
     wx_hi = jnp.where(is_ssealu, sse_out_hi, ssm_hi)
     xr = jnp.clip(dr, 0, 15)
