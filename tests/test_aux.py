@@ -177,3 +177,19 @@ def test_decode_pointer_matches_ntdll():
     expect = (((value >> rot) | (value << (64 - rot)))
               & (1 << 64) - 1) ^ cookie
     assert decode_pointer(cookie, value) == expect
+
+
+@pytest.mark.parametrize("backend_name", ["emu", "tpu"])
+def test_print_registers_dump(backend_name, capsys):
+    """PrintRegisters parity (backend.cc:309-332): six windbg-style rows
+    over the current lane."""
+    backend = create_backend(
+        backend_name, demo_tlv.build_snapshot(),
+        **({"n_lanes": 2} if backend_name == "tpu" else {}))
+    backend.initialize()
+    backend.rax(0x1122334455667788)
+    backend.print_registers()
+    out = capsys.readouterr().out.splitlines()
+    assert len(out) == 6
+    assert out[0].startswith("rax=1122334455667788")
+    assert out[2].split()[0].startswith("rip=")

@@ -260,6 +260,18 @@ class Backend(abc.ABC):
         the name becomes the on-disk filename under crashes/."""
         self.stop(Crash(f"crash-{exception_kind}-{exception_address:#x}"))
 
+    def print_registers(self) -> None:
+        """Windbg-style register dump of the current lane (reference
+        PrintRegisters, backend.cc:309-332) — the harness-debugging aid
+        breakpoint handlers reach for."""
+        rows = (("rax", "rbx", "rcx"), ("rdx", "rsi", "rdi"),
+                ("rip", "rsp", "rbp"), ("r8", "r9", "r10"),
+                ("r11", "r12", "r13"), ("r14", "r15"))
+        for row in rows:
+            print(" ".join(
+                f"{name:>3}={(self.rip() if name == 'rip' else getattr(self, name)()):016x}"
+                for name in row))
+
 
     # -- batch facade ------------------------------------------------------
     def run_batch(self, insert: List[bytes], target) -> List[TestcaseResult]:
