@@ -274,3 +274,50 @@ def test_vex128_memory_and_rejects():
     # a nonzero vvvv is not something an assembler emits; craft the bytes
     # (C5 f0 10 ca = vvvv=xmm1)
     assert decode(bytes([0xC5, 0x70, 0x10, 0xCA]) + pad).opc == OPC_INVALID
+
+
+@pytest.mark.parametrize("op", ["addsd", "subsd", "mulsd", "divsd",
+                                "minsd", "maxsd", "cmplesd"])
+def test_sd_random_battery_vs_hardware(op):
+    """Seeded random bit-pattern sweep per op — 60 pairs drawn from the
+    full f64 space (incl. NaN payload and denormal regions) against the
+    live host CPU."""
+    import random
+
+    rng = random.Random(hash(op) & 0xFFFFFFFF)
+    kind = "cmp" if op.startswith("cmp") else None
+    snippet = _sse_snippet(op, kind)
+    for _ in range(60):
+        shape = rng.randrange(4)
+        if shape == 0:      # uniform bits
+            a, b = rng.getrandbits(64), rng.getrandbits(64)
+        elif shape == 1:    # NaN/inf region (exp all-ones)
+            a = 0x7FF0000000000000 | (rng.getrandbits(52)) | (
+                rng.getrandbits(1) << 63)
+            b = rng.getrandbits(64)
+        elif shape == 2:    # denormal region
+            a = rng.getrandbits(52) | (rng.getrandbits(1) << 63)
+            b = rng.getrandbits(52) | (rng.getrandbits(1) << 63)
+        else:               # near-equal magnitudes (cancellation)
+            a = rng.getrandbits(64)
+            b = a ^ rng.getrandbits(3)
+        hw_regs, _, cpu = _run_both(snippet, {"rax": a, "rcx": b})
+        assert cpu.gpr[0] == hw_regs[0], (
+            f"{op}({a:#018x},{b:#018x}): emu={cpu.gpr[0]:#018x} "
+            f"hw={hw_regs[0]:#018x}")
+
+
+@pytest.mark.parametrize("op", ["addps", "mulps", "divps", "minps"])
+def test_ps_random_battery_vs_hardware(op):
+    """Same sweep for packed single: 40 random 128-bit pairs per op."""
+    import random
+
+    rng = random.Random(~hash(op) & 0xFFFFFFFF)
+    snippet = _sse_snippet(op, None, packed=True)
+    for _ in range(40):
+        regs = {r: rng.getrandbits(64) for r in ("rax", "rdx", "rcx", "rsi")}
+        hw_regs, _, cpu = _run_both(snippet, regs)
+        for slot in (0, 2):
+            assert cpu.gpr[slot] == hw_regs[slot], (
+                f"{op} {regs}: emu={cpu.gpr[slot]:#018x} "
+                f"hw={hw_regs[slot]:#018x}")
