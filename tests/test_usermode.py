@@ -189,3 +189,23 @@ def test_delivery_soak_random_campaign():
         assert name.startswith(("crash-read-", "crash-write-",
                                 "crash-execute-", "crash-divide-by-zero-",
                                 "crash-av", "crash-int-")), name
+
+
+def test_traced_run_through_delivery(tmp_path):
+    """A rip trace of a testcase that takes a #PF -> kernel handler ->
+    iretq round trip must contain user, kernel, and post-retry rips in
+    order (tracing delegates to the oracle, which delivers too)."""
+    backend = make_backend("tpu", n_lanes=2)
+    du.TARGET.insert_testcase(backend, GROW4)
+    path = tmp_path / "t.rip"
+    backend.set_trace_file(path, "rip")
+    result = backend.run()
+    assert isinstance(result, Ok), result
+    rips = [int(x, 16) for x in path.read_text().split()]
+    kern = [r for r in rips if r >= du.KERN_CODE]
+    user = [r for r in rips if r < du.KERN_CODE]
+    assert kern and user
+    assert rips[0] == du.USER_CODE
+    # the handler ran BETWEEN user rips (fault -> kernel -> retry)
+    first_kern = rips.index(kern[0])
+    assert any(r < du.KERN_CODE for r in rips[first_kern + 1:])
