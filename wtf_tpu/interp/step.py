@@ -390,6 +390,23 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     is_call, is_ret = is_(U.OPC_CALL), is_(U.OPC_RET)
     is_leave = is_(U.OPC_LEAVE)
     is_sse = is_(U.OPC_SSEMOV) | is_(U.OPC_SSEALU)
+    is_ssefp = is_(U.OPC_SSEFP)
+    # SSE-FP memory-operand byte counts mirror the oracle's virt_read sizes
+    # exactly (emu._exec_ssefp) so page-boundary fault behavior matches:
+    # elementwise forms read 16 (packed) / elem; converts have their own
+    # shapes (the DQ/PS/PD block reads a full 16 even for cvtdq2pd, an
+    # oracle-internal convention both engines share).
+    fp_is_ew = (sub <= U.FP_SQRT) | (sub == U.FP_CMP)  # arith/minmax/sqrt/cmp
+    fp_ldsize = jnp.select(
+        [sub == U.FP_CVT_I2F,
+         (sub == U.FP_CVT_F2I) | (sub == U.FP_CVT_F2I_T)
+         | (sub == U.FP_UCOMI) | (sub == U.FP_COMI),
+         sub == U.FP_CVT_F2F,
+         fp_is_ew],
+        [opsize, srcsize0,
+         jnp.where(sext_f == 1, srcsize0 * 2, srcsize0),
+         jnp.where(sext_f == 1, jnp.int32(16), srcsize0)],
+        default=jnp.int32(16))
 
     # -- unsupported classes -> host oracle fallback ----------------------
     rax, rdx = gpr[0], gpr[2]
@@ -402,7 +419,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     unsupported = pre_live & (
         is_(U.OPC_INVALID) | is_(U.OPC_IRET) | is_(U.OPC_MSR)
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
-        | is_(U.OPC_STACKSTR) | is_(U.OPC_VZEROALL) | is_(U.OPC_SSEFP)
+        | is_(U.OPC_STACKSTR) | is_(U.OPC_VZEROALL)
         | is_(U.OPC_X87)
         | (is_(U.OPC_LEAVE) & (sub == 1))  # enter: oracle-serviced
         # pinsrw m16: a 2-byte load outside the 16-byte operand window
@@ -447,7 +464,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
                 jnp.where(is_pop | is_popf | is_ret, rsp,
                  jnp.where(is_leave, rbp, ea))))
     l1_size = jnp.where(is_popf | is_ret | is_leave, 8,
-               jnp.where(is_pop | is_string | is_sse, opsize, srcsize))
+               jnp.where(is_pop | is_string | is_sse, opsize,
+                jnp.where(is_ssefp, fp_ldsize, srcsize)))
 
     # store-only destinations (MOV/SETCC/POP write [mem] without reading it)
     # must NOT issue a dst-read load: their fault is the *store* fault, so
@@ -988,6 +1006,289 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         ptest_cf, jnp.bool_(False), jnp.bool_(False), ptest_zf,
         jnp.bool_(False), jnp.bool_(False))
 
+    # -- SSE/SSE2 floating point (OPC_SSEFP), device execution ------------
+    # Same semantics as the oracle's _SseFp (emu.py), element-level: NaN
+    # payloads preserved and SNaNs quieted at the BIT level (never relying
+    # on what NaN the platform's FP unit produces), the dst NaN wins for
+    # arithmetic, min/max/cmp forward the second operand on NaN/equality,
+    # out-of-range converts produce the integer indefinite.  Normal-range
+    # arithmetic rides the platform's f32/f64 units (IEEE bit-exact on the
+    # CPU backend — tests/test_step_fp.py pins device == oracle == host
+    # CPU); denormal-touching lanes detect themselves and divert to the
+    # oracle (see below).  Residual TPU-only caveat: div/sqrt rounding is
+    # the platform's — a documented fidelity delta of the fast path,
+    # mirroring the bochs-vs-KVM precision split in the reference design.
+    fp_elem8 = srcsize0 == 8       # 4 = float32 lanes, 8 = float64 lanes
+    _m32 = _u(0xFFFFFFFF)
+    fpa32 = jnp.stack([x_dst_lo & _m32, x_dst_lo >> _u(32),
+                       x_dst_hi & _m32, x_dst_hi >> _u(32)]).astype(jnp.uint32)
+    fpb32 = jnp.stack([x_src_lo & _m32, x_src_lo >> _u(32),
+                       x_src_hi & _m32, x_src_hi >> _u(32)]).astype(jnp.uint32)
+    fpa64 = jnp.stack([x_dst_lo, x_dst_hi])
+    fpb64 = jnp.stack([x_src_lo, x_src_hi])
+    fa32 = lax.bitcast_convert_type(fpa32, jnp.float32)
+    fb32 = lax.bitcast_convert_type(fpb32, jnp.float32)
+    fa64 = lax.bitcast_convert_type(fpa64, jnp.float64)
+    fb64 = lax.bitcast_convert_type(fpb64, jnp.float64)
+
+    _QBIT32, _QBIT64 = jnp.uint32(0x00400000), _u(0x0008000000000000)
+    _INDEF32, _INDEF64 = jnp.uint32(0xFFC00000), _u(0xFFF8000000000000)
+
+    def _nan32(u):
+        return (u & jnp.uint32(0x7FFFFFFF)) > jnp.uint32(0x7F800000)
+
+    def _nan64(u):
+        return (u & _u(0x7FFFFFFFFFFFFFFF)) > _u(0x7FF0000000000000)
+
+    def _b32(f):
+        return lax.bitcast_convert_type(f, jnp.uint32)
+
+    def _b64(f):
+        return lax.bitcast_convert_type(f, jnp.uint64)
+
+    nan_a32, nan_b32 = _nan32(fpa32), _nan32(fpb32)
+    nan_a64, nan_b64 = _nan64(fpa64), _nan64(fpb64)
+
+    # Denormals: XLA flushes them (FTZ/DAZ) on both the CPU and TPU
+    # backends, where the oracle (numpy on the host thread) honors them.
+    # Any lane whose FP op *touches* the denormal range — denormal input,
+    # or a result the hardware would flush — is routed to the oracle
+    # through the same UNSUPPORTED servicing seam, so the fast path keeps
+    # the overwhelming normal-range majority and the rare denormal op
+    # stays bit-exact.  Detection is conservative (over-flagging is only
+    # a performance event, never a correctness one).
+    def _den32(u):
+        return ((u & jnp.uint32(0x7F800000)) == 0) \
+            & ((u & jnp.uint32(0x7FFFFFFF)) != 0)
+
+    def _den64(u):
+        return ((u & _u(0x7FF0000000000000)) == _u(0)) \
+            & ((u & _u(0x7FFFFFFFFFFFFFFF)) != _u(0))
+
+    def _fp_elementwise(fa, fb, ua, ub, nan_a, nan_b, bits, qbit, indef,
+                        nanf, denf, magmask, expmask):
+        """arith/minmax/sqrt/cmp over one lane-width's vector (f32[4]/f64[2]).
+
+        Returns (result_bits, denormal_risk) per lane."""
+        r_arith = jnp.select(
+            [sub == U.FP_ADD, sub == U.FP_SUB, sub == U.FP_MUL,
+             sub == U.FP_DIV],
+            [fa + fb, fa - fb, fa * fb, fa / fb], default=fa)
+        r_bits = bits(r_arith)
+        arith_out = jnp.where(
+            nan_a, ua | qbit,
+            jnp.where(nan_b, ub | qbit,
+                      jnp.where(nanf(r_bits), indef, r_bits)))
+        take_a = jnp.where(sub == U.FP_MIN, fa < fb, fa > fb)
+        mm_out = jnp.where(nan_a | nan_b | (fa == fb), ub,
+                           jnp.where(take_a, ua, ub))
+        sq_out = jnp.where(
+            nan_b, ub | qbit,
+            jnp.where(fb < 0, indef, bits(jnp.sqrt(fb))))
+        unord = nan_a | nan_b
+        pred = (imm & _u(7)).astype(jnp.int32)
+        eq, lt, le = fa == fb, fa < fb, fa <= fb
+        cmp_res = jnp.select(
+            [pred == 0, pred == 1, pred == 2, pred == 3,
+             pred == 4, pred == 5, pred == 6],
+            [~unord & eq, ~unord & lt, ~unord & le, unord,
+             unord | ~eq, unord | ~lt, unord | ~le],
+            default=~unord)
+        ones = ~jnp.zeros_like(ua)
+        cmp_out = jnp.where(cmp_res, ones, jnp.zeros_like(ua))
+        out = jnp.select(
+            [(sub >= U.FP_ADD) & (sub <= U.FP_DIV),
+             (sub == U.FP_MIN) | (sub == U.FP_MAX),
+             sub == U.FP_SQRT],
+            [arith_out, mm_out, sq_out], default=cmp_out)
+        # FTZ risk: a flushed result reads as +/-0 where the true result
+        # was a nonzero denormal; true zeros are exactly the listed cases
+        r_zero = (r_bits & magmask) == 0
+        true_zero = jnp.select(
+            [sub == U.FP_ADD, sub == U.FP_SUB, sub == U.FP_MUL],
+            [fa == -fb, fa == fb,
+             ((ua & magmask) == 0) | ((ub & magmask) == 0)],
+            default=((ua & magmask) == 0) | ((ub & magmask) == expmask))
+        ftz = ((sub >= U.FP_ADD) & (sub <= U.FP_DIV)) \
+            & r_zero & ~true_zero & ~nan_a & ~nan_b
+        den_in = jnp.where(sub == U.FP_SQRT, denf(ub), denf(ua) | denf(ub))
+        return out, ftz | den_in
+
+    ew32, ewrisk32 = _fp_elementwise(
+        fa32, fb32, fpa32, fpb32, nan_a32, nan_b32, _b32, _QBIT32,
+        _INDEF32, _nan32, _den32, jnp.uint32(0x7FFFFFFF),
+        jnp.uint32(0x7F800000))
+    ew64, ewrisk64 = _fp_elementwise(
+        fa64, fb64, fpa64, fpb64, nan_a64, nan_b64, _b64, _QBIT64,
+        _INDEF64, _nan64, _den64, _u(0x7FFFFFFFFFFFFFFF),
+        _u(0x7FF0000000000000))
+
+    def _limbs32(v32):
+        v = v32.astype(jnp.uint64)
+        return v[0] | (v[1] << _u(32)), v[2] | (v[3] << _u(32))
+
+    ew_lo32, ew_hi32 = _limbs32(ew32)
+    ew_lo = jnp.where(fp_elem8, ew64[0], ew_lo32)
+    ew_hi = jnp.where(fp_elem8, ew64[1], ew_hi32)
+
+    fp_is_f2i = (sub == U.FP_CVT_F2I) | (sub == U.FP_CVT_F2I_T)
+    fp_is_comi = (sub == U.FP_UCOMI) | (sub == U.FP_COMI)
+
+    # lanes an op actually reads (scalar forms must not flag junk in the
+    # upper lanes of the destination register)
+    used32 = jnp.where(sext_f == 1, jnp.ones(4, bool),
+                       jnp.arange(4) == 0)
+    used64 = jnp.where(sext_f == 1, jnp.ones(2, bool),
+                       jnp.arange(2) == 0)
+    ew_risk = jnp.where(fp_elem8, jnp.any(ewrisk64 & used64),
+                        jnp.any(ewrisk32 & used32))
+    comi_risk = jnp.where(fp_elem8,
+                          _den64(fpa64[0]) | _den64(fpb64[0]),
+                          _den32(fpa32[0]) | _den32(fpb32[0]))
+    # f2f: s2d flags denormal f32 inputs (DAZ); d2s flags any f64 input
+    # small enough that the f32 result lands at/under the normal minimum
+    d2s_small = (((fpb64 >> _u(52)) & _u(0x7FF)) <= _u(897)) \
+        & ((fpb64 & _u(0x7FFFFFFFFFFFFFFF)) != _u(0))
+    f2f_risk = jnp.where(fp_elem8, jnp.any(d2s_small & used64),
+                         jnp.any(_den32(fpb32)
+                                 & jnp.where(sext_f == 1,
+                                             jnp.arange(4) < 2,
+                                             jnp.arange(4) == 0)))
+    fp_denorm = is_ssefp & jnp.select(
+        [fp_is_ew, fp_is_comi, sub == U.FP_CVT_F2F],
+        [ew_risk, comi_risk, f2f_risk], default=jnp.bool_(False))
+
+    # ucomis/comis flag image: unordered -> ZF=PF=CF=1; else ZF=(a==b),
+    # CF=(a<b), PF=0; OF/AF/SF cleared (oracle set_flags call)
+    uc_unord = jnp.where(fp_elem8, nan_a64[0] | nan_b64[0],
+                         nan_a32[0] | nan_b32[0])
+    uc_eq = jnp.where(fp_elem8, fa64[0] == fb64[0], (fa32[0] == fb32[0]))
+    uc_lt = jnp.where(fp_elem8, fa64[0] < fb64[0], (fa32[0] < fb32[0]))
+    ucomi_rf = (rf & ~_u(FLAGS_ARITH)) | _mkflags(
+        uc_unord | (~uc_unord & uc_lt), uc_unord, jnp.bool_(False),
+        uc_unord | (~uc_unord & uc_eq), jnp.bool_(False), jnp.bool_(False))
+
+    # int -> fp scalar (cvtsi2ss/sd): integer comes from a GPR or an
+    # opsize-wide memory load, sign-extended, rounded ONCE by the convert
+    i2f_raw = jnp.where(sk == U.K_REG, _read_reg(gpr, sr, opsize),
+                        l1_lo & _size_mask(opsize))
+    i2f_int = _sext(i2f_raw, opsize).astype(jnp.int64)
+    i2f_b32 = _b32(i2f_int.astype(jnp.float32)).astype(jnp.uint64)
+    i2f_b64 = _b64(i2f_int.astype(jnp.float64))
+    i2f_lo = jnp.where(fp_elem8, i2f_b64, i2f_b32)
+
+    # fp -> int (cvt/cvtt to gpr, and the packed PS2DQ/PD2DQ families):
+    # rounding/range logic runs in f64 exactly like the oracle's to_int
+    # (f32 widens losslessly first), indefinite = 1 << (bits-1)
+    def _fp_to_int(v64, int_bits, truncate, src_nan):
+        limit = jnp.float64(2.0) ** (int_bits - 1)
+        r = jnp.where(truncate, jnp.trunc(v64),
+                      lax.round(v64, lax.RoundingMethod.TO_NEAREST_EVEN))
+        bad = src_nan | jnp.isnan(v64) | (r >= limit) | (r < -limit)
+        indef = _u(1) << jnp.uint64(int_bits - 1)
+        safe = jnp.clip(r, -limit, limit - 1)
+        return jnp.where(bad, indef,
+                         safe.astype(jnp.int64).astype(jnp.uint64)
+                         & _size_mask(jnp.int32(int_bits // 8)))
+
+    f2i_src64 = jnp.where(fp_elem8, fb64[0], fb32[0].astype(jnp.float64))
+    f2i_nan = jnp.where(fp_elem8, nan_b64[0], nan_b32[0])
+    f2i_trunc = sub == U.FP_CVT_F2I_T
+    f2i_val = jnp.where(
+        opsize >= 8,
+        _fp_to_int(f2i_src64, 64, f2i_trunc, f2i_nan),
+        _fp_to_int(f2i_src64, 32, f2i_trunc, f2i_nan))
+
+    # f32 <-> f64 converts: NaNs rebuilt at the bit level (payload shifted,
+    # quiet bit forced) so the device never depends on platform NaN rules
+    def _cvt_s2d(u32v, f32v, isnan):
+        sign = (u32v.astype(jnp.uint64) >> _u(31)) << _u(63)
+        frac = (u32v.astype(jnp.uint64) & _u(0x7FFFFF)) << _u(29)
+        nan_bits = sign | _u(0x7FF0000000000000) | _QBIT64 | frac
+        return jnp.where(isnan, nan_bits, _b64(f32v.astype(jnp.float64)))
+
+    def _cvt_d2s(u64v, f64v, isnan):
+        sign = (u64v >> _u(63)).astype(jnp.uint32) << jnp.uint32(31)
+        frac = ((u64v >> _u(29)) & _u(0x3FFFFF)).astype(jnp.uint32)
+        nan_bits = sign | jnp.uint32(0x7F800000) | _QBIT32 | frac
+        return jnp.where(isnan, nan_bits, _b32(f64v.astype(jnp.float32)))
+
+    s2d = _cvt_s2d(fpb32, fb32, nan_b32)          # u64[4], lanes 0/1 used
+    d2s = _cvt_d2s(fpb64, fb64, nan_b64)          # u32[2]
+    f2f_packed_lo4 = s2d[0]                        # cvtps2pd
+    f2f_packed_hi4 = s2d[1]
+    f2f_packed_lo8 = (d2s[0].astype(jnp.uint64)
+                      | (d2s[1].astype(jnp.uint64) << _u(32)))  # cvtpd2ps
+    f2f_lo = jnp.where(fp_elem8, f2f_packed_lo8, f2f_packed_lo4)
+    f2f_hi = jnp.where(fp_elem8, _u(0), f2f_packed_hi4)
+
+    # packed int <-> fp families (each writes the full register)
+    dq2ps = _b32(fpb32.astype(jnp.int32).astype(jnp.float32))
+    ps2dq_t = sub == U.FP_CVT_PS2DQ_T
+    ps2dq = jnp.stack([
+        _fp_to_int(fb32[i].astype(jnp.float64), 32, ps2dq_t, nan_b32[i])
+        for i in range(4)]).astype(jnp.uint32)
+    dq2pd_lo = _b64(fpb32[0].astype(jnp.int32).astype(jnp.float64))
+    dq2pd_hi = _b64(fpb32[1].astype(jnp.int32).astype(jnp.float64))
+    pd2dq_t = sub == U.FP_CVT_PD2DQ_T
+    pd2dq = jnp.stack([
+        _fp_to_int(fb64[i], 32, pd2dq_t, nan_b64[i]) for i in range(2)])
+    pd2dq_lo = (pd2dq[0] & _m32) | ((pd2dq[1] & _m32) << _u(32))
+
+    # shufps/shufpd, unpckl/h ps/pd: pure lane shuffles
+    shuf_sel = imm
+    sh32_src = jnp.concatenate([fpa32, fpb32])    # picks: dst,dst,src,src
+    shufps = jnp.stack([
+        sh32_src[jnp.where(jnp.int32(i) < 2, jnp.int32(0), jnp.int32(4))
+                 + ((shuf_sel >> _u(2 * i)) & _u(3)).astype(jnp.int32)]
+        for i in range(4)])
+    shufpd_lo = jnp.where((shuf_sel & _u(1)) != 0, x_dst_hi, x_dst_lo)
+    shufpd_hi = jnp.where((shuf_sel & _u(2)) != 0, x_src_hi, x_src_lo)
+    shufps_lo, shufps_hi = _limbs32(shufps)
+    unp_h = sub == U.FP_UNPCKH
+    unpck32 = jnp.stack([
+        jnp.where(unp_h, fpa32[2], fpa32[0]), jnp.where(unp_h, fpb32[2], fpb32[0]),
+        jnp.where(unp_h, fpa32[3], fpa32[1]), jnp.where(unp_h, fpb32[3], fpb32[1])])
+    unpck32_lo, unpck32_hi = _limbs32(unpck32)
+    unpck64_lo = jnp.where(unp_h, x_dst_hi, x_dst_lo)
+    unpck64_hi = jnp.where(unp_h, x_src_hi, x_src_lo)
+
+    fp_sub_sel = [
+        sub == U.FP_CVT_I2F,
+        sub == U.FP_CVT_F2F,
+        sub == U.FP_CVT_DQ2PS,
+        (sub == U.FP_CVT_PS2DQ) | (sub == U.FP_CVT_PS2DQ_T),
+        sub == U.FP_CVT_DQ2PD,
+        (sub == U.FP_CVT_PD2DQ) | (sub == U.FP_CVT_PD2DQ_T),
+        sub == U.FP_SHUF,
+        (sub == U.FP_UNPCKL) | (sub == U.FP_UNPCKH),
+    ]
+    dq2ps_lo, dq2ps_hi = _limbs32(dq2ps)
+    ps2dq_lo, ps2dq_hi = _limbs32(ps2dq)
+    fp_res_lo = jnp.select(fp_sub_sel, [
+        i2f_lo, f2f_lo, dq2ps_lo, ps2dq_lo, dq2pd_lo, pd2dq_lo,
+        jnp.where(fp_elem8, shufpd_lo, shufps_lo),
+        jnp.where(fp_elem8, unpck64_lo, unpck32_lo),
+    ], default=ew_lo)
+    fp_res_hi = jnp.select(fp_sub_sel, [
+        _u(0), f2f_hi, dq2ps_hi, ps2dq_hi, dq2pd_hi, _u(0),
+        jnp.where(fp_elem8, shufpd_hi, shufps_hi),
+        jnp.where(fp_elem8, unpck64_hi, unpck32_hi),
+    ], default=ew_hi)
+    # destination write width: 16 = whole register, else low bytes merge
+    fp_wsz = jnp.select(
+        [sub == U.FP_CVT_I2F,
+         sub == U.FP_CVT_F2F,
+         fp_is_ew],
+        [srcsize0,
+         jnp.where(sext_f == 1, jnp.int32(16), 12 - srcsize0),
+         jnp.where(sext_f == 1, jnp.int32(16), srcsize0)],
+        default=jnp.int32(16))
+    fp_wlo_mask = _size_mask(jnp.minimum(fp_wsz, 8))
+    fp_out_lo = (x_dst_lo & ~fp_wlo_mask) | (fp_res_lo & fp_wlo_mask)
+    fp_out_hi = jnp.where(fp_wsz >= 16, fp_res_hi, x_dst_hi)
+    fp_writes_xmm = is_ssefp & ~fp_is_f2i & ~fp_is_comi
+
     # -- 5. result routing -------------------------------------------------
     cc01 = jnp.where(cc_true, _u(1), _u(0))
     is_mul = is_(U.OPC_MUL)
@@ -1024,6 +1325,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_XCHG), dk == U.K_REG),
         (is_ssemov, (sub == 2) & (dk == U.K_REG)),
         (is_ssealu, (sub == U.SSE_PMOVMSKB) | (sub == U.SSE_PEXTRW)),
+        (is_ssefp, fp_is_f2i),
     ], jnp.bool_(False))
     w1_idx = opc_list([
         (is_mul, jnp.where(is_mul2, dr, i0)),
@@ -1064,6 +1366,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_XCHG), src_val),
         (is_ssemov, xmm[jnp.clip(sr, 0, 15), 0]),
         (is_ssealu, jnp.where(sub == U.SSE_PEXTRW, pextrw_val, pmov_mask)),
+        (is_ssefp, f2i_val),
     ], _u(0))
     w1_size = opc_list([
         (is_mul, jnp.where(is_mul2, opsize,
@@ -1160,7 +1463,9 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     store_fault = st_need & ~(ts0.ok & ts1.ok & ts0.writable & ts1.writable)
 
     page_fault = live & ~unsupported & ~is_crash & (fault1 | fault2 | store_fault)
-    commit_pre = live & ~unsupported & ~is_crash & ~de & ~page_fault
+    fp_oracle = live & ~unsupported & ~page_fault & fp_denorm
+    commit_pre = live & ~unsupported & ~is_crash & ~de & ~page_fault \
+        & ~fp_oracle
 
     overlay, store_ok = store_window3(image, overlay, ts0, ts1, st_size,
                                       st_lo, st_hi, st_need & commit_pre)
@@ -1201,6 +1506,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_popf, popf_rf),
         (is_(U.OPC_SYSCALL), jnp.where(syscall_entry, syscall_rf, sysret_rf)),
         (is_ssealu & (sub == U.SSE_PTEST), ptest_rf),
+        (is_ssefp & fp_is_comi, ucomi_rf),
     ], rf)
     new_rf = jnp.where(commit, rf_exec | _u(0x2), rf)
 
@@ -1242,9 +1548,12 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     wx_cond = commit & (
         (is_ssemov & (sub != 2) & (dk == U.K_XMM))
         | (is_ssealu & (sub != U.SSE_PMOVMSKB) & (sub != U.SSE_PTEST)
-           & (sub != U.SSE_PEXTRW)))
-    wx_lo = jnp.where(is_ssealu, sse_out_lo, ssm_lo)
-    wx_hi = jnp.where(is_ssealu, sse_out_hi, ssm_hi)
+           & (sub != U.SSE_PEXTRW))
+        | fp_writes_xmm)
+    wx_lo = jnp.where(is_ssefp, fp_out_lo,
+                      jnp.where(is_ssealu, sse_out_lo, ssm_lo))
+    wx_hi = jnp.where(is_ssefp, fp_out_hi,
+                      jnp.where(is_ssealu, sse_out_hi, ssm_hi))
     xr = jnp.clip(dr, 0, 15)
     new_xmm = xmm.at[xr].set(jnp.where(
         wx_cond, jnp.stack([wx_lo, wx_hi]), xmm[xr]))
@@ -1278,11 +1587,12 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     # -- status ------------------------------------------------------------
     S = StatusCode
     status_chain = jnp.select(
-        [miss, at_bp, smc, unsupported, page_fault, de, is_crash, ovf,
-         cr3_changed, timed],
+        [miss, at_bp, smc, unsupported, page_fault, fp_oracle, de, is_crash,
+         ovf, cr3_changed, timed],
         [jnp.int32(int(S.NEED_DECODE)), jnp.int32(int(S.BREAKPOINT)),
          jnp.int32(int(S.SMC)), jnp.int32(int(S.UNSUPPORTED)),
-         jnp.int32(int(S.PAGE_FAULT)), jnp.int32(int(S.DIVIDE_ERROR)),
+         jnp.int32(int(S.PAGE_FAULT)), jnp.int32(int(S.UNSUPPORTED)),
+         jnp.int32(int(S.DIVIDE_ERROR)),
          jnp.int32(int(S.CRASH)), jnp.int32(int(S.OVERLAY_FULL)),
          jnp.int32(int(S.CR3_CHANGE)), jnp.int32(int(S.TIMEDOUT))],
         default=jnp.int32(int(S.RUNNING)))
