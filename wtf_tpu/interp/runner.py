@@ -356,8 +356,8 @@ def _lane_cpu_state(view: HostView, lane: int, snapshot_cpu: CpuState) -> CpuSta
     cpu.fptw = int(view.r["fptw"][lane])
     cpu.mxcsr = int(view.r["mxcsr"][lane])
     for i in range(16):
-        cpu.zmm[i][0] = int(view.r["xmm"][lane, i, 0])
-        cpu.zmm[i][1] = int(view.r["xmm"][lane, i, 1])
+        for limb in range(4):
+            cpu.zmm[i][limb] = int(view.r["xmm"][lane, i, limb])
     return cpu
 
 
@@ -390,6 +390,8 @@ def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
     for i in range(16):
         view.r["xmm"][lane, i, 0] = np.uint64(cpu.xmm[i][0] & MASK64)
         view.r["xmm"][lane, i, 1] = np.uint64(cpu.xmm[i][1] & MASK64)
+        view.r["xmm"][lane, i, 2] = np.uint64(cpu.ymmh[i][0] & MASK64)
+        view.r["xmm"][lane, i, 3] = np.uint64(cpu.ymmh[i][1] & MASK64)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -493,11 +495,17 @@ class Runner:
             self._chunk_sizes.append(
                 min(self._chunk_sizes[-1] * 16, 1 << 16))
         self._chunk_level = 0
+        # consecutive service rounds per lane (oracle burst sizing)
+        self._fallback_streak: Dict[int, int] = {}
+        # (lane, uop-entry) coverage bits owed by oracle burst steps;
+        # OR-ed into machine.cov at the next push
+        self._pending_cov: List[Tuple[int, int]] = []
         # run statistics (reference PrintRunStats role, backend.h:218)
         self.stats = {
             "chunks": 0, "decodes": 0, "decodes_prefetched": 0,
-            "fallbacks": 0, "smc_updates": 0,
+            "fallbacks": 0, "fallback_burst_steps": 0, "smc_updates": 0,
             "bp_dispatches": 0, "exceptions_delivered": 0,
+            "max_chunk_steps": chunk_steps,
         }
 
     # -- host memory access ------------------------------------------------
@@ -505,12 +513,26 @@ class Runner:
         return HostView(self)
 
     def push(self, view: HostView) -> None:
-        """Apply a HostView's mutations (registers + buffered page writes)
-        back to the device batch."""
+        """Apply a HostView's mutations (registers + buffered page writes +
+        burst coverage bits) back to the device batch."""
         updates = {
             name: jnp.asarray(view.r[name]) for name in _MIRROR_FIELDS
         }
         self.machine = self.machine._replace(**updates)
+        if self._pending_cov:
+            # combine host-side to unique (lane, word) pairs so the
+            # device read-modify-write scatter is deterministic
+            acc: Dict[Tuple[int, int], int] = {}
+            for lane, idx in self._pending_cov:
+                key = (lane, idx >> 5)
+                acc[key] = acc.get(key, 0) | (1 << (idx & 31))
+            lanes = jnp.asarray([k[0] for k in acc], dtype=jnp.int32)
+            words = jnp.asarray([k[1] for k in acc], dtype=jnp.int32)
+            bits = jnp.asarray(list(acc.values()), dtype=jnp.uint32)
+            cov = self.machine.cov
+            cov = cov.at[lanes, words].set(cov[lanes, words] | bits)
+            self.machine = self.machine._replace(cov=cov)
+            self._pending_cov.clear()
         if view.pending:
             items = sorted(view.pending.items())
             k = len(items)
@@ -683,6 +705,71 @@ class Runner:
         else:
             view.set_status(lane, StatusCode.RUNNING)
 
+    # Opcode classes only the oracle executes.  The burst below may run
+    # AHEAD through these; every burst-stepped rip is published to the
+    # decode cache and its coverage bit is OR-ed into the device bitmap
+    # at the next push (`_pending_cov`), so burst lanes report exactly
+    # the coverage a per-dispatch servicing loop would have.  A device-
+    # executable instruction ends the burst so its coverage/edge bits
+    # land through the normal device path.
+    _ORACLE_OPCS = frozenset((
+        U.OPC_X87, U.OPC_MSR, U.OPC_SSECVT, U.OPC_PEXT, U.OPC_PCLMUL,
+        U.OPC_STACKSTR, U.OPC_IRET,
+    ))
+
+    def _oracle_entry_at(self, view: HostView, lane: int,
+                         rip: int) -> Optional[int]:
+        """Uop-table entry index at `rip` when it decodes to an
+        oracle-class instruction (publishing it on a miss), else None."""
+        uop = self.cache.uops.get(rip)
+        if uop is None:
+            try:
+                window = view.virt_read(lane, rip, 15)
+                pfn0 = view.translate(lane, rip) >> PAGE_SHIFT
+            except HostFault:
+                return None
+            uop = decode(window, rip)
+            if uop.opc == U.OPC_INVALID:
+                return None
+            try:
+                pfn1 = view.translate(
+                    lane, rip + max(uop.length - 1, 0)) >> PAGE_SHIFT
+            except HostFault:
+                pfn1 = pfn0
+            self.cache.add(rip, uop, pfn0, pfn1)
+        if (uop.opc in self._ORACLE_OPCS
+                or (uop.opc == U.OPC_LEAVE and uop.sub == 1)):  # enter
+            return self.cache.index[rip]
+        return None
+
+    def _fallback_burst(self, view: HostView, lane: int) -> None:
+        """Service an UNSUPPORTED lane; when the lane has needed the oracle
+        for consecutive rounds (an x87/MSR-dense region), keep stepping it
+        host-side through further oracle-class instructions so its progress
+        per round grows instead of staying one-instruction-per-chunk.
+        Stops at armed breakpoints (the device checks them pre-execution)
+        and at the first device-executable instruction."""
+        self._fallback_step(view, lane)
+        streak = self._fallback_streak.get(lane, 0) + 1
+        self._fallback_streak[lane] = streak
+        if streak < 2:
+            return
+        budget = min(32 << min(streak, 6), 1024)
+        while budget > 0:
+            if view.get_status(lane) != StatusCode.RUNNING:
+                return
+            rip = view.get_rip(lane)
+            if self.cache.has_breakpoint(rip):
+                return
+            idx = self._oracle_entry_at(view, lane, rip)
+            if idx is None:
+                return
+            self._fallback_step(view, lane)
+            # the coverage bit the device dispatch would have recorded
+            self._pending_cov.append((lane, idx))
+            self.stats["fallback_burst_steps"] += 1
+            budget -= 1
+
     def _service_exception(self, view: HostView, lane: int) -> bool:
         """Vector a faulted lane through the guest IDT (reference: bochs
         delivers internally, bochscpu_backend.cc:917-999; KVM injects,
@@ -732,9 +819,14 @@ class Runner:
         tab = self.cache.device()
         limit = jnp.uint64(self.limit)
         self._chunk_level = 0
+        self._fallback_streak = {}
         undeliverable: Set[int] = set()  # lanes whose IDT delivery failed
         for _ in range(max_chunks):
-            run_chunk = (make_run_chunk(self._chunk_sizes[self._chunk_level])
+            size = (self._chunk_sizes[self._chunk_level]
+                    if self.adaptive_chunks else self.chunk_steps)
+            self.stats["max_chunk_steps"] = max(
+                self.stats["max_chunk_steps"], size)
+            run_chunk = (make_run_chunk(size)
                          if self.adaptive_chunks else self._run_chunk)
             self.machine = run_chunk(
                 tab, self.physmem.image, self.machine, limit)
@@ -763,15 +855,33 @@ class Runner:
                         and self._chunk_level < len(self._chunk_sizes) - 1):
                     self._chunk_level += 1
                 continue
-            self._chunk_level = 0  # servicing needed: back to fine-grained
+            # Chunk-size policy: broad servicing (decode misses, SMC churn,
+            # breakpoint dispatch — events that gate the BATCH's forward
+            # progress) drops back to fine-grained chunks; a few chronic
+            # oracle-bound lanes must NOT stall everyone (VERDICT r4 item
+            # 4), so UNSUPPORTED/fault-only rounds keep growing the ladder
+            # and the chronic lanes ride the oracle burst instead.
+            broad = bool(need[int(StatusCode.NEED_DECODE)]
+                         or need[int(StatusCode.SMC)]
+                         or need[int(StatusCode.BREAKPOINT)])
+            if broad:
+                self._chunk_level = 0
+            elif (self.adaptive_chunks
+                    and self._chunk_level < len(self._chunk_sizes) - 1):
+                self._chunk_level += 1
+
+            unsup_lanes = need[int(StatusCode.UNSUPPORTED)]
+            self._fallback_streak = {
+                lane: self._fallback_streak.get(lane, 0)
+                for lane in unsup_lanes}
 
             view = self.view()
             if need[int(StatusCode.NEED_DECODE)]:
                 self._service_decode(view, need[int(StatusCode.NEED_DECODE)])
             if need[int(StatusCode.SMC)]:
                 self._service_smc(view, need[int(StatusCode.SMC)])
-            for lane in need[int(StatusCode.UNSUPPORTED)]:
-                self._fallback_step(view, lane)
+            for lane in unsup_lanes:
+                self._fallback_burst(view, lane)
             for lane in (need.get(int(StatusCode.PAGE_FAULT), [])
                          + need.get(int(StatusCode.DIVIDE_ERROR), [])):
                 if not self._service_exception(view, lane):
@@ -803,6 +913,7 @@ class Runner:
         SURVEY.md §5.4)."""
         self.machine = machine_restore(self.machine, self.template)
         self.lane_errors.clear()
+        self._pending_cov.clear()
         # per-testcase SMC thrash window: a rip legitimately rewritten many
         # times within ONE run falls back to the oracle, but the count must
         # not accumulate across the campaign (fresh-run behavior parity)
