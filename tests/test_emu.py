@@ -580,23 +580,24 @@ def test_vex_after_prefix_is_invalid():
     assert decode(bytes([0xC4, 0xE3, 0x43, 0xF0, 0xC3, 0x0D]) +
                   b"\x90" * 8).opc == OPC_INVALID
     # vzeroupper is strict too: pp != 0 or vvvv != 1111b #UDs
-    from wtf_tpu.cpu.uops import OPC_NOP
+    from wtf_tpu.cpu.uops import OPC_VZEROALL
 
-    assert decode(bytes([0xC5, 0xF8, 0x77]) + b"\x90" * 8).opc == OPC_NOP
+    vz = decode(bytes([0xC5, 0xF8, 0x77]) + b"\x90" * 8)
+    assert (vz.opc, vz.sub) == (OPC_VZEROALL, 1)
     assert decode(bytes([0xC5, 0xF9, 0x77]) + b"\x90" * 8).opc == OPC_INVALID
     assert decode(bytes([0xC5, 0xB8, 0x77]) + b"\x90" * 8).opc == OPC_INVALID
 
 
 def test_vzeroall_zeroes_xmm_state():
     """vzeroall (VEX.256 0F 77) zeroes the FULL vector registers — XMM
-    state included — unlike vzeroupper (VEX.128), which is a true no-op in
-    this YMM-less machine model.  ADVICE r3: previously decoded INVALID
-    and produced a spurious invalid-opcode crash."""
+    state included; vzeroupper (VEX.128) zeroes only the upper YMM halves,
+    leaving XMM intact.  ADVICE r3: previously decoded INVALID and
+    produced a spurious invalid-opcode crash."""
     from wtf_tpu.cpu.decoder import decode
-    from wtf_tpu.cpu.uops import OPC_NOP, OPC_VZEROALL
+    from wtf_tpu.cpu.uops import OPC_VZEROALL
 
     assert decode(bytes([0xC5, 0xFC, 0x77]) + b"\x90" * 8).opc == OPC_VZEROALL
-    assert decode(bytes([0xC5, 0xF8, 0x77]) + b"\x90" * 8).opc == OPC_NOP
+    assert decode(bytes([0xC5, 0xF8, 0x77]) + b"\x90" * 8).sub == 1
     cpu = run_emu("""
         mov rax, 0x1122334455667788
         movq xmm3, rax
@@ -657,6 +658,49 @@ def test_retf_same_and_inter_privilege():
     assert cpu.cs_sel == 0x10
     assert cpu.ss_sel == 0x2B
     assert cpu.gpr[1] == 0x7FFDF000  # rsp came from the far frame
+
+
+def test_retf_imm16_inter_privilege_releases_new_stack():
+    """SDM RET-far: with a CPL change, imm16 releases parameter bytes from
+    BOTH stacks — the old one (before popping SS:RSP) and the new one
+    (after).  The restored rsp must be new_rsp + imm (ADVICE r4)."""
+    from tests.emurunner import STACK_TOP
+
+    new_rsp = STACK_TOP - 0x200
+    cpu = run_emu(
+        f"""
+        lea rax, [rip + landed]
+        push 0x2B                 # new ss
+        mov rbx, {new_rsp}
+        push rbx                  # new rsp
+        sub rsp, 0x10             # the imm16 param bytes sit between
+        push 0x10                 # cs (different RPL -> inter-priv)
+        push rax
+        retf 0x10
+    landed:
+        mov rcx, rsp
+        hlt
+        """)
+    assert cpu.cs_sel == 0x10
+    assert cpu.gpr[1] == new_rsp + 0x10  # imm released on the NEW stack too
+
+
+def test_jecxz_a32():
+    """67h jecxz tests ECX, not RCX (ADVICE r4: the a32 form must not
+    silently take jrcxz semantics)."""
+    cpu = run_emu(
+        """
+        mov rcx, 0xF00000000     # ECX == 0 but RCX != 0
+        jrcxz bad
+        jecxz ok                 # 67 E3: must branch on ECX == 0
+    bad:
+        mov rax, 0xBAD
+        hlt
+    ok:
+        mov rax, 0x600D
+        hlt
+        """)
+    assert cpu.gpr[0] == 0x600D
 
 
 def test_enter_leave_roundtrip():

@@ -67,6 +67,8 @@ def assert_matches_oracle(asm, data=None, regs=None, n_lanes=2,
         for i in range(16):
             assert int(xmm[lane, i, 0]) == emu.xmm[i][0], f"xmm{i} lo"
             assert int(xmm[lane, i, 1]) == emu.xmm[i][1], f"xmm{i} hi"
+            assert int(xmm[lane, i, 2]) == emu.ymmh[i][0], f"ymm{i} up lo"
+            assert int(xmm[lane, i, 3]) == emu.ymmh[i][1], f"ymm{i} up hi"
     if check_mem:
         view = runner.view()
         for pfn in emu.mem.dirty_pfns():
@@ -200,6 +202,17 @@ DIFF_CASES = [
         mov rax, 99
         skip2:
         skip1:
+        hlt""", None),
+    ("jecxz_a32", """
+        mov rcx, 0xF00000000
+        jecxz taken
+        mov rax, 99
+        taken:
+        mov rbx, 7
+        mov ecx, 1
+        jecxz bad
+        mov rbx, 1
+        bad:
         hlt""", None),
     ("push_imm_leave", """
         push 0x1234

@@ -321,3 +321,80 @@ def test_xsave_xrstor_context_switch_shape():
     # the first XSAVE image header recorded both components
     import struct as s
     assert s.unpack_from("<Q", cpu.virt_read(area + 512, 8), 0)[0] == 3
+
+
+def _zmm_with_ymm(idx_vals):
+    zmm = [[0] * 8 for _ in range(32)]
+    for idx, (lo, hi) in idx_vals.items():
+        zmm[idx][2], zmm[idx][3] = lo, hi
+    return zmm
+
+
+def test_ymm_state_carries_through_xsave_avx():
+    """VERDICT r4 item 5: a snapshot captured with live YMM state must
+    round-trip — the upper halves ride CpuState.zmm into the machine, the
+    xsave AVX component (RFBM bit 2, standard offset 576) services them,
+    and vzeroupper/xrstor behave architecturally."""
+    area = 0x2000_0000
+    ymm = {3: (0x1111222233334444, 0x5555666677778888),
+           12: (0xAAAABBBBCCCCDDDD, 0x0123456789ABCDEF)}
+    cpu = run_emu(
+        f"""
+        mov rbx, {area}
+        mov eax, 7                    # RFBM = x87|SSE|AVX
+        xor edx, edx
+        xsave [rbx]                   # writes the AVX component
+        vzeroupper                    # clears ONLY the upper halves
+        mov eax, 4
+        xor edx, edx
+        xsave [rbx+0x800]             # AVX-only image of cleared state
+        mov eax, 4
+        xor edx, edx
+        xrstor [rbx]                  # bring the upper halves back
+        hlt
+        """,
+        data={area: bytes(0x1000)},
+        regs={"zmm": _zmm_with_ymm(ymm)})
+    import struct as s
+
+    # first image: AVX component saved at offset 576, XSTATE_BV bit 2 set
+    assert s.unpack_from("<Q", cpu.virt_read(area + 512, 8), 0)[0] & 4
+    lo, hi = s.unpack_from("<QQ", cpu.virt_read(area + 576 + 16 * 3, 16), 0)
+    assert (lo, hi) == ymm[3]
+    lo, hi = s.unpack_from("<QQ", cpu.virt_read(area + 576 + 16 * 12, 16), 0)
+    assert (lo, hi) == ymm[12]
+    # second image captured the vzeroupper-cleared state
+    lo, hi = s.unpack_from(
+        "<QQ", cpu.virt_read(area + 0x800 + 576 + 16 * 3, 16), 0)
+    assert (lo, hi) == (0, 0)
+    # xrstor restored the original upper halves
+    assert cpu.ymmh[3] == list(ymm[3])
+    assert cpu.ymmh[12] == list(ymm[12])
+
+
+def test_ymm_state_device_round_trip():
+    """The device machine carries the upper YMM limbs untouched through
+    SSE execution, and vzeroupper/vzeroall execute ON DEVICE (no oracle
+    fallback) with the architectural split."""
+    import sys
+    sys.path.insert(0, "tests")
+    from test_step import assert_matches_oracle, make_runner
+    from wtf_tpu.core.results import StatusCode
+
+    ymm = _zmm_with_ymm({1: (0xDEAD, 0xBEEF), 15: (0x77, 0x88)})
+    # legacy SSE writes to xmm1 must preserve its upper YMM half
+    assert_matches_oracle(
+        "movq xmm1, rax\npaddq xmm1, xmm1\nmovq rbx, xmm1\nhlt",
+        regs={"rax": 21, "zmm": ymm})
+    # vzeroupper on device: uppers cleared, xmm preserved, zero fallbacks
+    runner = make_runner(
+        "movq xmm1, rax\nvzeroupper\nmovq rbx, xmm1\nhlt",
+        regs={"rax": 42, "zmm": ymm})
+    status = runner.run()
+    assert all(StatusCode(int(s)) == StatusCode.CRASH for s in status)
+    assert runner.stats["fallbacks"] == 0
+    import numpy as np
+    xmm = np.asarray(runner.machine.xmm)
+    assert int(xmm[0, 1, 0]) == 42          # xmm kept
+    assert int(xmm[0, 1, 2]) == 0           # upper half cleared
+    assert int(xmm[0, 15, 2]) == 0

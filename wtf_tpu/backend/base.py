@@ -68,6 +68,14 @@ class Backend(abc.ABC):
     @abc.abstractmethod
     def get_reg(self, idx: int) -> int: ...
 
+    def get_xmm(self, idx: int) -> int:
+        """128-bit XMM read (reference GetReg covers vector regs too,
+        bochscpu_backend.cc:1124-1190)."""
+        raise NotImplementedError
+
+    def set_xmm(self, idx: int, value: int) -> None:
+        raise NotImplementedError
+
     @abc.abstractmethod
     def set_reg(self, idx: int, value: int) -> None: ...
 

@@ -193,6 +193,13 @@ class EmuBackend(Backend):
     def set_reg(self, idx: int, value: int) -> None:
         self.cpu.gpr[idx] = value & (1 << 64) - 1
 
+    def get_xmm(self, idx: int) -> int:
+        lo, hi = self.cpu.xmm[idx]
+        return lo | (hi << 64)
+
+    def set_xmm(self, idx: int, value: int) -> None:
+        self.cpu.xmm[idx] = [value & (1 << 64) - 1, (value >> 64) & (1 << 64) - 1]
+
     def get_rip(self) -> int:
         return self.cpu.rip
 

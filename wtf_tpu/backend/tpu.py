@@ -292,6 +292,8 @@ class TpuBackend(Backend):
         for i in range(16):
             cpu.xmm[i][0] = int(view.r["xmm"][0, i, 0])
             cpu.xmm[i][1] = int(view.r["xmm"][0, i, 1])
+            cpu.ymmh[i][0] = int(view.r["xmm"][0, i, 2])
+            cpu.ymmh[i][1] = int(view.r["xmm"][0, i, 3])
         cpu.icount = int(view.r["icount"][0])
         cpu.rdrand_state = int(view.r["rdrand"][0])
         self._view = None
@@ -315,6 +317,15 @@ class TpuBackend(Backend):
 
     def set_reg(self, idx: int, value: int) -> None:
         self._ensure_view().set_reg(self._lane, idx, value)
+
+    def get_xmm(self, idx: int) -> int:
+        r = self._ensure_view().r["xmm"]
+        return int(r[self._lane, idx, 0]) | (int(r[self._lane, idx, 1]) << 64)
+
+    def set_xmm(self, idx: int, value: int) -> None:
+        r = self._ensure_view().r["xmm"]
+        r[self._lane, idx, 0] = np.uint64(value & (1 << 64) - 1)
+        r[self._lane, idx, 1] = np.uint64((value >> 64) & (1 << 64) - 1)
 
     def get_rip(self) -> int:
         return self._ensure_view().get_rip(self._lane)

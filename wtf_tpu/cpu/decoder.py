@@ -10,8 +10,11 @@ how the reference's backends surface unknown situations as explicit results,
 reference src/wtf/backend.h:12-31).
 
 Decoding model: legacy prefixes -> REX -> opcode (1-byte map, 0F map,
-0F 38 map) -> ModRM/SIB/disp -> immediate.  67h address-size and far/segment
-forms are out of scope (never emitted by 64-bit compilers) and decode invalid.
+0F 38 map) -> ModRM/SIB/disp -> immediate.  67h address-size overrides
+decode (EA truncates to 32 bits; jecxz tests ECX) except on string ops,
+whose 32-bit rsi/rdi/rcx semantics neither engine models — those refuse
+loudly as OPC_INVALID.  Far/segment-load forms are out of scope (never
+emitted by 64-bit compilers) and decode invalid.
 """
 
 from __future__ import annotations
@@ -355,12 +358,11 @@ def _decode_vex(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
 
     if mmmmm == 1 and opc == 0x77 and pp == 0 and vvvv == 0:
         # pp/vvvv must be 0 — hardware #UDs otherwise.
-        # L=0: vzeroupper — no YMM state in this machine model, so an
-        #      architectural no-op (compilers emit it at AVX/SSE
-        #      transition points).
-        # L=1: vzeroall — zeroes the full registers, XMM state included:
-        #      a real operation here, serviced by the oracle.
-        uop.opc = OPC_VZEROALL if l_bit else OPC_NOP
+        # L=1: vzeroall — zeroes the full registers (sub 0).
+        # L=0: vzeroupper — zeroes only the upper YMM halves (sub 1);
+        #      compilers emit it at AVX/SSE transition points.
+        # Both oracle-serviced.
+        uop.opc, uop.sub = OPC_VZEROALL, (0 if l_bit else 1)
         return
 
     if l_bit:  # VEX.256 (AVX) — not in the scalar subset
@@ -766,8 +768,8 @@ def _decode_primary(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         uop.opsize = 8 if pfx.rex_w else 4
         return
 
-    if op == 0xE3:  # jrcxz
-        uop.opc, uop.cond = OPC_JCC, 16  # special cond: rcx == 0
+    if op == 0xE3:  # jrcxz (67h: jecxz tests ECX — special cond 17)
+        uop.opc, uop.cond = OPC_JCC, (17 if pfx.asize else 16)
         uop.opsize = 8
         uop.imm = _sx(cur.u8(), 8)
         return

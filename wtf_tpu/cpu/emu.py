@@ -190,6 +190,12 @@ class EmuCpu:
         self.snapshot = state
         self.gpr: List[int] = [0] * 16
         self.xmm: List[List[int]] = [[0, 0] for _ in range(16)]
+        # Upper YMM halves (2 limbs each): carried for AVX-bearing
+        # snapshot round-trip and the xsave AVX component (reference
+        # CpuState_t holds 32xZMM, globals.h:1020-1159); no instruction
+        # in the executed subset COMPUTES on them — CPUID steers
+        # feature-dispatched code onto SSE2 paths
+        self.ymmh: List[List[int]] = [[0, 0] for _ in range(16)]
         self.rip = 0
         self.rflags = 0x2
         self.cr3 = 0
@@ -261,6 +267,7 @@ class EmuCpu:
         self.cr3_event = None
         for i in range(16):
             self.xmm[i] = [state.zmm[i][0], state.zmm[i][1]]
+            self.ymmh[i] = [state.zmm[i][2], state.zmm[i][3]]
 
     # -- registers ------------------------------------------------------
     def read_reg(self, idx: int, size: int) -> int:
@@ -445,6 +452,8 @@ class EmuCpu:
         ]
         if cc == 16:  # jrcxz
             return self.gpr[1] == 0  # rcx
+        if cc == 17:  # jecxz (67h form)
+            return self.gpr[1] & 0xFFFFFFFF == 0
         return table[cc]
 
     # -- addressing -----------------------------------------------------
@@ -639,7 +648,9 @@ class EmuCpu:
                     self.gpr[4] = rsp  # frame continues at adjusted rsp
                     new_rsp = self.read_u(self.gpr[4], 8)
                     self.ss_sel = self.read_u(self.gpr[4] + 8, 8) & 0xFFFF
-                    rsp = new_rsp & MASK64
+                    # SDM RET-far: imm16 releases parameter bytes from the
+                    # NEW stack as well after popping SS:RSP
+                    rsp = (new_rsp + uop.imm) & MASK64
                 self.rip = new_rip
                 self.cs_sel = new_cs
                 self.gpr[4] = rsp
@@ -830,11 +841,12 @@ class EmuCpu:
             self.write_reg(0, 4, 0x7)  # x87+SSE+AVX state enabled
             self.write_reg(2, 4, 0)
         elif opc == U.OPC_VZEROALL:
-            # zeroes the full vector registers — XMM state included (the
-            # L=0 form, vzeroupper, is a decoder-level NOP instead: no
-            # YMM state exists in this machine model)
+            # sub 0: vzeroall — the full vector registers; sub 1:
+            # vzeroupper — only the upper YMM halves
             for i in range(16):
-                self.xmm[i] = [0, 0]
+                if uop.sub == 0:
+                    self.xmm[i] = [0, 0]
+                self.ymmh[i] = [0, 0]
         elif opc == U.OPC_SYSCALL:
             if uop.sub == 0:
                 self.gpr[1] = next_rip                       # rcx
@@ -1584,17 +1596,25 @@ class EmuCpu:
         elif sub == U.X87_FXRSTOR:
             self._fxrstor_image(self.virt_read(ea, 512))
         elif sub == U.X87_XSAVE:
-            # XSAVE64 with RFBM = edx:eax; x87 (bit 0) + SSE (bit 1) are
-            # the components this machine model carries — the kernel
-            # context-switch path.  The legacy region is the fxsave image;
-            # XSTATE_BV in the header records what was saved.
-            rfbm = ((self.gpr[2] << 32) | (self.gpr[0] & 0xFFFFFFFF)) & 0x3
+            # XSAVE64 with RFBM = edx:eax; x87 (bit 0) + SSE (bit 1) +
+            # AVX (bit 2, the upper YMM halves at the standard offset
+            # 576) are the components this machine model carries — the
+            # kernel context-switch path.  The legacy region is the
+            # fxsave image; XSTATE_BV in the header records what saved.
+            rfbm = ((self.gpr[2] << 32) | (self.gpr[0] & 0xFFFFFFFF)) & 0x7
             img = bytearray(self._fxsave_image())
             header = bytearray(64)
             _s.pack_into("<Q", header, 0, rfbm)  # XSTATE_BV
-            self.virt_write(ea, bytes(img) + bytes(header))
+            out = bytes(img) + bytes(header)
+            if rfbm & 4:
+                avx = bytearray(256)
+                for r in range(16):
+                    _s.pack_into("<QQ", avx, 16 * r,
+                                 self.ymmh[r][0], self.ymmh[r][1])
+                out += bytes(avx)
+            self.virt_write(ea, out)
         elif sub == U.X87_XRSTOR:
-            rfbm = ((self.gpr[2] << 32) | (self.gpr[0] & 0xFFFFFFFF)) & 0x3
+            rfbm = ((self.gpr[2] << 32) | (self.gpr[0] & 0xFFFFFFFF)) & 0x7
             raw = self.virt_read(ea, 576)
             (xstate_bv,) = _s.unpack_from("<Q", raw, 512)
             use = rfbm & xstate_bv
@@ -1615,6 +1635,15 @@ class EmuCpu:
                     self.mxcsr = 0x1F80
                     for r in range(16):
                         self._write_xmm_bytes(r, bytes(16), merge=False)
+            if rfbm & 4:
+                if use & 4:
+                    avx = self.virt_read((ea + 576) & MASK64, 256)
+                    for r in range(16):
+                        lo, hi = _s.unpack_from("<QQ", avx, 16 * r)
+                        self.ymmh[r] = [lo, hi]
+                else:
+                    for r in range(16):
+                        self.ymmh[r] = [0, 0]
         else:
             raise UnsupportedInsn(self.rip, uop.raw)
 

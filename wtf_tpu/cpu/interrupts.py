@@ -89,14 +89,17 @@ def deliver_page_fault(ctx, gva: int, write: bool, read_translates) -> None:
 
     One implementation for both engines (the oracle backend and the batch
     runner) so what the guest handler sees can never diverge between
-    them.  `read_translates(gva) -> bool` is the engine's probe: a write
-    that READ-translates is a protection violation (P=1), anything else
-    is non-present (P=0); U comes from the ctx's CPL.
+    them.  `read_translates(gva) -> bool` is the engine's presence probe
+    (translate ignoring the access direction): P reflects whether the
+    page is mapped — a faulting access to a PRESENT page is a protection
+    violation (P=1), e.g. a write through a read-only PTE; anything
+    unmapped is non-present (P=0), the demand-paging shape a real
+    Windows MmAccessFault distinguishes.  U comes from the ctx's CPL.
     """
     if (gva >> 47) not in (0, 0x1FFFF):  # non-canonical: #GP, not #PF
         deliver_exception(ctx, VEC_GP, 0)
         return
-    present = bool(write) and read_translates(gva)
+    present = read_translates(gva)
     err = pf_error_code(present, write, (ctx.cs_sel & 3) == 3)
     deliver_exception(ctx, VEC_PF, err, cr2=gva)
 

@@ -85,9 +85,9 @@ OPC_PCLMUL = 44    # reserved
 OPC_PEXT = 45      # bmi: sub-op BMI_*
 OPC_STACKSTR = 46  # push/pop of segment etc (rare; unsupported)
 OPC_MSR = 47       # rdmsr/wrmsr (sub: 0 read, 1 write); oracle-serviced
-OPC_VZEROALL = 48  # vzeroall: zeroes xmm0-15 (no YMM state in this
-                   # model); oracle-serviced — rare enough not to earn a
-                   # device path
+OPC_VZEROALL = 48  # sub 0: vzeroall (whole vector file); sub 1:
+                   # vzeroupper (upper YMM halves only) — both execute
+                   # on the device as whole-file writes
 OPC_SSEFP = 49     # SSE/SSE2 floating point (sub FP_*; srcsize = element
                    # width 4/8, sext = 1 for packed forms).  The dominant
                    # decode gap measured on real Windows-PE codegen

@@ -35,7 +35,10 @@ class Machine(NamedTuple):
     gpr: jax.Array        # uint64[L, 16] (x86 encoding order)
     rip: jax.Array        # uint64[L]
     rflags: jax.Array     # uint64[L]
-    xmm: jax.Array        # uint64[L, 16, 2] (lo, hi limbs)
+    xmm: jax.Array        # uint64[L, 16, 4] YMM as 4 limbs: device ops
+                          # compute on limbs 0-1; limbs 2-3 (upper YMM)
+                          # are carried for AVX snapshot round-trip
+                          # (reference globals.h:1020-1159 32xZMM)
     fs_base: jax.Array    # uint64[L]
     gs_base: jax.Array    # uint64[L]
     kernel_gs_base: jax.Array  # uint64[L]
@@ -106,10 +109,10 @@ def machine_init(
         return jnp.asarray(ones * np.uint64(value & (1 << 64) - 1))
 
     gpr = np.tile(np.array(cpu.gpr_list(), dtype=np.uint64), (n_lanes, 1))
-    xmm = np.zeros((n_lanes, 16, 2), dtype=np.uint64)
+    xmm = np.zeros((n_lanes, 16, 4), dtype=np.uint64)
     for i in range(16):
-        xmm[:, i, 0] = np.uint64(cpu.zmm[i][0])
-        xmm[:, i, 1] = np.uint64(cpu.zmm[i][1])
+        for limb in range(4):
+            xmm[:, i, limb] = np.uint64(cpu.zmm[i][limb])
 
     return Machine(
         gpr=jnp.asarray(gpr),

@@ -222,7 +222,8 @@ def _eval_cond(rf, rcx, cc):
         zf | (sf != of), ~zf & (sf == of),
     ])
     base = conds[jnp.clip(cc, 0, 15)]
-    return jnp.where(cc == 16, rcx == _u(0), base)  # jrcxz
+    base = jnp.where(cc == 16, rcx == _u(0), base)  # jrcxz
+    return jnp.where(cc == 17, (rcx & _u(0xFFFFFFFF)) == _u(0), base)  # jecxz
 
 
 # ---------------------------------------------------------------------------
@@ -419,7 +420,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     unsupported = pre_live & (
         is_(U.OPC_INVALID) | is_(U.OPC_IRET) | is_(U.OPC_MSR)
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
-        | is_(U.OPC_STACKSTR) | is_(U.OPC_VZEROALL)
+        | is_(U.OPC_STACKSTR)
         | is_(U.OPC_X87)
         | (is_(U.OPC_LEAVE) & (sub == 1))  # enter: oracle-serviced
         # pinsrw m16: a 2-byte load outside the 16-byte operand window
@@ -1555,8 +1556,18 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     wx_hi = jnp.where(is_ssefp, fp_out_hi,
                       jnp.where(is_ssealu, sse_out_hi, ssm_hi))
     xr = jnp.clip(dr, 0, 15)
-    new_xmm = xmm.at[xr].set(jnp.where(
-        wx_cond, jnp.stack([wx_lo, wx_hi]), xmm[xr]))
+    # limbs 0-1 only: upper YMM halves (limbs 2-3) are carried state the
+    # legacy-SSE subset never computes on (AVX snapshots round-trip;
+    # reference CpuState_t holds 32xZMM, globals.h:1020-1159)
+    new_xmm = xmm.at[xr, 0].set(jnp.where(wx_cond, wx_lo, xmm[xr, 0]))
+    new_xmm = new_xmm.at[xr, 1].set(jnp.where(wx_cond, wx_hi, new_xmm[xr, 1]))
+    # vzeroall (sub 0) zeroes the whole file; vzeroupper (sub 1) the
+    # upper halves only — whole-file writes, no dst register
+    vz = commit & is_(U.OPC_VZEROALL)
+    vz_limb = jnp.where(vz & (sub == 0), jnp.arange(4) >= 0,
+                        jnp.where(vz, jnp.arange(4) >= 2,
+                                  jnp.zeros(4, bool)))
+    new_xmm = jnp.where(vz_limb[None, :], _u(0), new_xmm)
 
     # -- bookkeeping -------------------------------------------------------
     new_icount = st.icount + jnp.where(commit, _u(1), _u(0))
