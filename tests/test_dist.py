@@ -307,7 +307,7 @@ def test_malformed_result_frame_drops_node_not_master(tmp_path):
     sock.close()
     assert not thread.is_alive()
     assert server.stats.testcases == 0     # nothing counted from garbage
-    assert server.paths == [BENIGN]        # in-flight work requeued
+    assert list(server.paths) == [BENIGN]  # in-flight work requeued
 
 
 def test_partial_mux_batch_is_all_or_nothing(tmp_path):

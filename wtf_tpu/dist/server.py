@@ -20,12 +20,14 @@ Reference `Server_t` (src/wtf/server.h): a single-threaded select() reactor
 from __future__ import annotations
 
 import hashlib
+import re
 import selectors
 import socket
 import struct
 import time
+from collections import deque
 from pathlib import Path
-from typing import Dict, List, Optional, Set
+from typing import Deque, Dict, List, Optional, Set
 
 from wtf_tpu.core.results import Cr3Change, Crash, OverlayFull, Timedout
 from wtf_tpu.dist import wire
@@ -104,8 +106,8 @@ class Server:
         # materialize in memory at startup); dirwatch injections are bytes.
         from wtf_tpu.fuzz.corpus import seed_paths
 
-        self.paths: List = [
-            p for p, _ in seed_paths([inputs_dir, corpus.outputs_dir])]
+        self._paths: Deque = deque(
+            p for p, _ in seed_paths([inputs_dir, corpus.outputs_dir]))
         self._dirwatch = None
         self._dirwatch_last = 0.0
         if inputs_dir:
@@ -124,10 +126,21 @@ class Server:
         self._clients: Dict[socket.socket, _Conn] = {}
         self._sel: Optional[selectors.BaseSelector] = None
 
+    @property
+    def paths(self) -> Deque:
+        """Seed queue (deque: popleft each serve, prepend on requeue —
+        a plain list's pop(0)/[:0] is quadratic under a large resumed
+        corpus with churn).  Assignment accepts any iterable."""
+        return self._paths
+
+    @paths.setter
+    def paths(self, items) -> None:
+        self._paths = deque(items)
+
     # -- testcase generation (server.h:629-714) ----------------------------
     def _next_seed(self) -> Optional[bytes]:
         while self.paths:
-            item = self.paths.pop(0)
+            item = self.paths.popleft()
             if isinstance(item, Path):
                 try:
                     return item.read_bytes()[:self.max_len]
@@ -180,15 +193,17 @@ class Server:
         if isinstance(result, Crash):
             self.stats.crashes += 1
             if result.name:
-                # the name crossed the WIRE: sanitize before using it as a
-                # filename (a hostile node must not steer the write path)
-                name = result.name.replace("/", "_").replace(
-                    "\\", "_").lstrip(".")[:200] or "crash-unnamed"
+                # the name crossed the WIRE: whitelist-sanitize before
+                # using it as a filename (a hostile node must not steer
+                # the write path; NUL/control bytes would otherwise take
+                # down open() with ValueError, not OSError)
+                name = re.sub(r"[^A-Za-z0-9._-]", "_",
+                              result.name).lstrip(".")[:200] or "crash-unnamed"
                 self.crash_names.add(name)
                 if self.crashes_dir:
                     try:
                         (self.crashes_dir / name).write_bytes(testcase)
-                    except OSError as e:
+                    except (OSError, ValueError) as e:
                         print(f"crash save failed for {name!r}: {e}")
         elif isinstance(result, Timedout):
             self.stats.timeouts += 1
@@ -250,7 +265,7 @@ class Server:
                             continue  # vanished after the scan
                     # prepend: freshly dropped seeds are served next,
                     # ahead of any undrained initial corpus
-                    self.paths[:0] = injected
+                    self.paths.extendleft(reversed(injected))
                 self._maybe_print()
         finally:
             for sock in list(self._clients):
@@ -315,7 +330,7 @@ class Server:
             # client remains connected; elasticity, server.h:534-544)
             self._clients[sock].inflight = []
             self._drop(sock)
-            self.paths[:0] = batch
+            self.paths.extendleft(reversed(batch))
 
     def _on_readable(self, sock: socket.socket) -> None:
         conn = self._clients[sock]
@@ -360,7 +375,7 @@ class Server:
         # a dying client's in-flight testcases are re-served to others
         conn = self._clients.pop(sock, None)
         if conn is not None and conn.inflight:
-            self.paths[:0] = conn.inflight
+            self.paths.extendleft(reversed(conn.inflight))
         try:
             self._sel.unregister(sock)
         except (KeyError, ValueError):
