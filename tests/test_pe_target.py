@@ -1,0 +1,105 @@
+"""demo_pe: real Windows machine code end to end (VERDICT r4 item 3).
+
+These tests execute REAL MSVC codegen — `gle64.vc14.dll` out of PyOpenGL's
+wheel, the same census-verified image the README decode table measures —
+through both backends: loader-style image mapping, synthetic import
+stubs, an actual exported function, and a genuine attacker-controlled
+OOB read that faults off the end of the testcase buffer.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from wtf_tpu.backend import create_backend
+from wtf_tpu.core.results import Crash, Ok
+from wtf_tpu.harness import demo_pe
+
+pytestmark = pytest.mark.skipif(
+    not demo_pe.available(), reason="census DLL not present")
+
+BENIGN_PTS = struct.pack(
+    "<12d", 1.0, 2.0, 3.0, 2.0, 3.0, 4.0, 3.0, 4.0, 5.0, 4.0, 5.0, 6.0)
+BENIGN = struct.pack("<Id", 4, 0.5) + BENIGN_PTS
+OVERCLAIM = struct.pack("<Id", 100_000, 0.5) + BENIGN_PTS
+
+
+def make_backend(name, **kw):
+    backend = create_backend(name, demo_pe.build_snapshot(),
+                             limit=2_000_000, **kw)
+    backend.initialize()
+    demo_pe.TARGET.init(backend)
+    return backend
+
+
+def test_real_dll_executes_on_oracle():
+    be = make_backend("emu")
+    demo_pe.TARGET.insert_testcase(be, BENIGN)
+    result = be.run()
+    assert isinstance(result, Ok)
+    assert be.cpu.icount > 5000        # thousands of real MSVC instructions
+    be.restore()
+    demo_pe.TARGET.insert_testcase(be, OVERCLAIM)
+    crash = be.run()
+    assert isinstance(crash, Crash)
+    assert crash.name and "read" in crash.name
+
+
+def test_real_dll_crash_name_equality_across_backends():
+    """The canonical cross-backend check (reference README.md:241-243's
+    develop-on-bochs/validate-on-kvm workflow): identical results and
+    crash names from the oracle and the device on real code."""
+    results = {}
+    for backend_name in ("emu", "tpu"):
+        kw = {"n_lanes": 2} if backend_name == "tpu" else {}
+        be = make_backend(backend_name, **kw)
+        out = []
+        for tc in (BENIGN, OVERCLAIM, struct.pack("<Id", 0, 1.0)):
+            demo_pe.TARGET.insert_testcase(be, tc)
+            out.append(be.run())
+            be.restore()
+        results[backend_name] = out
+    for r_emu, r_tpu in zip(results["emu"], results["tpu"]):
+        assert type(r_emu) is type(r_tpu), (r_emu, r_tpu)
+        if isinstance(r_emu, Crash):
+            assert r_emu.name == r_tpu.name
+
+
+def test_real_dll_device_fp_stays_on_device():
+    """gle64's SSE2 floating point must ride the device fast path: the
+    round-4 regression was every FP instruction bouncing to the oracle."""
+    be = make_backend("tpu", n_lanes=2)
+    demo_pe.TARGET.insert_testcase(be, BENIGN)
+    result = be.run()
+    assert isinstance(result, Ok)
+    assert int(np.asarray(be.runner.machine.icount).max()) > 5000
+    # a handful of fallbacks are legitimate (none expected today); what
+    # must NOT happen is per-FP-instruction bouncing (thousands)
+    assert be.runner.stats["fallbacks"] < 50, be.runner.stats
+
+
+def test_real_dll_batch_campaign():
+    """A small coverage-guided batch on the device backend: mixed clean
+    and crashing inputs resolve per lane."""
+    be = make_backend("tpu", n_lanes=4)
+    results = be.run_batch(
+        [BENIGN, OVERCLAIM, struct.pack("<Id", 3, 2.0) + BENIGN_PTS[:72],
+         BENIGN], demo_pe.TARGET)
+    assert isinstance(results[0], Ok)
+    assert isinstance(results[1], Crash)
+    assert isinstance(results[3], Ok)
+    assert results[1].name == "crash-read-0x24002000"
+
+
+def test_pe_loader_exports_and_image():
+    from wtf_tpu.utils.pe import load_pe
+
+    pe = load_pe(demo_pe.DEFAULT_DLL)
+    exports = pe.exports()
+    assert exports["glePolyCylinder"] > 0
+    assert len(exports) == 25
+    img = pe.mapped_image()
+    assert img[:2] == b"MZ"
+    text = pe.section(".text")
+    assert img[text.vaddr:text.vaddr + 16] == pe.section_bytes(".text")[:16]

@@ -1,0 +1,81 @@
+"""Regenerate the hand-assembled import stubs embedded in
+wtf_tpu/harness/demo_pe.py (_STUBS).
+
+Run from the repo root: python tools/gen_pe_stubs.py
+Requires the test assembler helper (gas + objcopy).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tests"))
+
+from asmhelper import assemble  # noqa: E402
+
+from wtf_tpu.harness.demo_pe import HEAP_STATE  # noqa: E402
+
+STUBS = {
+    # zero-return: the whole GL/GLU/kernel32/CRT surface
+    "ret0": "xor eax, eax\nret",
+    # sin/cos/atan2/acos: deterministic 0.0 (values don't matter to the
+    # fuzzer; determinism and finiteness do)
+    "fpzero": "xorps xmm0, xmm0\nret",
+    # sqrt: the real thing (SSE2)
+    "sqrt": "sqrtsd xmm0, xmm0\nret",
+    # malloc(rcx) -> rax: 16-byte-aligned bump allocator over the HEAP
+    # arena; the bump pointer lives at HEAP_STATE so overlay reset
+    # rewinds the heap on restore
+    "malloc": f"""
+        mov r10, {HEAP_STATE}
+        mov rax, [r10]
+        lea rcx, [rcx + 15]
+        and rcx, -16
+        lea rdx, [rax + rcx]
+        mov [r10], rdx
+        ret
+    """,
+    # realloc(rcx=old, rdx=size): bump-alloc + copy `size` bytes from the
+    # old block (reads stay inside the mapped arena; realloc(NULL) works)
+    "realloc": f"""
+        mov r10, {HEAP_STATE}
+        mov rax, [r10]
+        lea r8, [rdx + 15]
+        and r8, -16
+        lea r9, [rax + r8]
+        mov [r10], r9
+        mov r9, rdi
+        mov r11, rsi
+        mov rdi, rax
+        mov rsi, rcx
+        mov rcx, rdx
+        test rsi, rsi
+        jz done
+        rep movsb
+    done:
+        mov rdi, r9
+        mov rsi, r11
+        ret
+    """,
+    # memset(rcx=dst, dl=val, r8=count) -> dst
+    "memset": """
+        mov r9, rdi
+        mov r10, rcx
+        mov rdi, rcx
+        movzx eax, dl
+        mov rcx, r8
+        rep stosb
+        mov rax, r10
+        mov rdi, r9
+        ret
+    """,
+}
+
+
+def main() -> None:
+    for name, asm in STUBS.items():
+        code = assemble(asm)
+        print(f'    "{name}": bytes.fromhex("{code.hex()}"),')
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,10 @@
                   src/tlv_server/tlv_server.cc + fuzzer_tlv_server.cc)
   demo_maze.py  - coverage-maze demo target: nested input checks that only
                   coverage-guided mutation can walk through
+  demo_pe.py    - REAL Windows machine code: maps an MSVC-built DLL
+                  (gle64.vc14.dll) loader-style with synthetic import
+                  stubs and fuzzes an actual export (the reference's
+                  real-snapshot posture, README.md:27-33)
 """
 
 from wtf_tpu.harness.targets import Target, Targets, register_target  # noqa: F401

@@ -81,6 +81,6 @@ def load_builtin_targets() -> None:
     runs (the reference compiles fuzzer_*.cc into the binary; our
     equivalent is importing the harness modules)."""
     from wtf_tpu.harness import (  # noqa: F401
-        demo_fs, demo_ioctl, demo_kernel, demo_maze, demo_spin,
+        demo_fs, demo_ioctl, demo_kernel, demo_maze, demo_pe, demo_spin,
         demo_tlv, demo_usermode,
     )

@@ -69,6 +69,50 @@ class PeImage:
                 return self._data[off:off + size]
         raise PeError(f"rva {rva:#x} outside every section")
 
+    def data_directory(self, index: int) -> Tuple[int, int]:
+        """(rva, size) of optional-header data directory `index`
+        (0 = exports, 1 = imports, 12 = IAT)."""
+        data = self._data
+        (pe_off,) = struct.unpack_from("<I", data, 0x3C)
+        (magic,) = struct.unpack_from("<H", data, pe_off + 24)
+        if magic != 0x20B:
+            raise PeError(f"{self.path.name}: not PE32+")
+        return struct.unpack_from("<II", data, pe_off + 24 + 112 + index * 8)
+
+    def exports(self) -> Dict[str, int]:
+        """name -> RVA from the export directory."""
+        erva, esize = self.data_directory(0)
+        if erva == 0:
+            return {}
+        exp = self.rva_bytes(erva, 40)
+        addr_rva, names_rva, ord_rva = struct.unpack_from("<III", exp, 28)
+        (nnames,) = struct.unpack_from("<I", exp, 24)
+        out: Dict[str, int] = {}
+        for i in range(nnames):
+            (nrva,) = struct.unpack_from(
+                "<I", self.rva_bytes(names_rva + 4 * i, 4))
+            name = self.rva_bytes(nrva, 256).split(b"\x00")[0].decode(
+                "latin-1")
+            (ordinal,) = struct.unpack_from(
+                "<H", self.rva_bytes(ord_rva + 2 * i, 2))
+            (frva,) = struct.unpack_from(
+                "<I", self.rva_bytes(addr_rva + 4 * ordinal, 4))
+            out[name] = frva
+        return out
+
+    def mapped_image(self) -> bytes:
+        """The image laid out as the loader would map it at image_base:
+        headers + sections at their RVAs, zero-filled virtual slack."""
+        end = max(s.vaddr + max(s.vsize, s.raw_size) for s in self.sections)
+        end = (end + 0xFFF) & ~0xFFF
+        img = bytearray(end)
+        hdr = min(0x1000, len(self._data))
+        img[:hdr] = self._data[:hdr]
+        for s in self.sections:
+            raw = self._data[s.raw_off:s.raw_off + min(s.raw_size, s.vsize)]
+            img[s.vaddr:s.vaddr + len(raw)] = raw
+        return bytes(img)
+
     def function_ranges(self) -> List[Tuple[int, int]]:
         """(begin, end) RVA pairs from the .pdata RUNTIME_FUNCTION table
         (x64 SEH unwind directory) — every non-leaf function the compiler
