@@ -1,0 +1,95 @@
+"""The measured bench denominator (native/bochsref.cc) must be a faithful
+executor of the demo_tlv workload: same ok/crash verdicts as the oracle
+on the same testcase stream, or its exec/s means nothing."""
+
+import ctypes
+import random
+
+import pytest
+
+from wtf_tpu.backend.emu import EmuBackend
+from wtf_tpu.core.results import Crash, Ok, Timedout
+from wtf_tpu.fuzz.corpus import Corpus
+from wtf_tpu.fuzz.native_mutator import best_mangle_mutator
+from wtf_tpu.harness import demo_tlv as T
+from wtf_tpu.native import build_library
+
+
+def _make_vm(lib):
+    u64, u8p = ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8)
+    rsp = T.STACK_TOP - 0x1000
+    stack_base = T.STACK_TOP - 0x8000
+    stack = bytearray(0x9000)
+    stack[rsp - stack_base:rsp - stack_base + 8] = T.FINISH_GVA.to_bytes(
+        8, "little")
+    spans = [
+        (T.CODE_GVA, T._GUEST_CODE.ljust(0x1000, b"\xcc")),
+        (T.FINISH_GVA, b"\x90\xf4".ljust(0x1000, b"\xcc")),
+        (T.INPUT_GVA, bytes(T.MAX_INPUT)),
+        (T.SCRATCH_GVA, bytes(0x1000)),
+        (stack_base, bytes(stack)),
+    ]
+    bases = (u64 * len(spans))(*[s[0] for s in spans])
+    sizes = (u64 * len(spans))(*[len(s[1]) for s in spans])
+    bufs = [(ctypes.c_uint8 * len(s[1])).from_buffer_copy(s[1])
+            for s in spans]
+    datas = (u8p * len(spans))(*[ctypes.cast(b, u8p) for b in bufs])
+    return lib.bochsref_create(bases, sizes, datas, len(spans)), rsp
+
+
+def test_bochsref_matches_oracle_verdicts():
+    path = build_library("bochsref", ["bochsref.cc"])
+    if path is None:
+        pytest.skip("no native toolchain")
+    lib = ctypes.CDLL(str(path))
+    u64, u32, u8p = (ctypes.c_uint64, ctypes.c_uint32,
+                     ctypes.POINTER(ctypes.c_uint8))
+    lib.bochsref_create.restype = ctypes.c_void_p
+    lib.bochsref_create.argtypes = [ctypes.POINTER(u64), ctypes.POINTER(u64),
+                                    ctypes.POINTER(u8p), ctypes.c_int]
+    lib.bochsref_campaign.argtypes = [
+        ctypes.c_void_p, u64, u64, u64, u64, u64,
+        u8p, ctypes.POINTER(u32), ctypes.c_int, u64, u64,
+        ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64)]
+    lib.bochsref_destroy.argtypes = [ctypes.c_void_p]
+
+    rng = random.Random(0xBEEF)
+    corpus = Corpus(rng=rng)
+    corpus.add(b"\x01\x04AAAA\x02\x08BBBBBBBB")
+    corpus.add(b"\x03\x30" + b"C" * 0x30)     # the planted smash
+    mutator = best_mangle_mutator(rng, max_len=0x200)
+    tcs = [mutator.get_new_testcase(corpus) for _ in range(64)]
+    tcs += [b"\x01\x04AAAA", b"\x03\x30" + b"C" * 0x30, b"", b"\x02\x03AB"]
+
+    # oracle verdicts
+    be = EmuBackend(T.build_snapshot(), limit=100_000)
+    be.initialize()
+    T.TARGET.init(be)
+    oracle = []
+    for tc in tcs:
+        T.TARGET.insert_testcase(be, tc)
+        r = be.run()
+        oracle.append(
+            "ok" if isinstance(r, Ok)
+            else "timeout" if isinstance(r, Timedout) else "crash")
+        be.restore()
+
+    # bochsref verdicts, one testcase at a time
+    vm, rsp = _make_vm(lib)
+    native = []
+    for tc in tcs:
+        flat = (ctypes.c_uint8 * max(len(tc), 1)).from_buffer_copy(
+            tc if tc else b"\x00")
+        lens = (u32 * 1)(len(tc))
+        execs = u64(0)
+        instr = u64(0)
+        crashes = u64(0)
+        lib.bochsref_campaign(
+            vm, T.CODE_GVA, rsp, T.INPUT_GVA, T.FINISH_GVA, T.SCRATCH_GVA,
+            ctypes.cast(flat, u8p), lens, 1, 100_000, 1,
+            ctypes.byref(execs), ctypes.byref(instr), ctypes.byref(crashes))
+        native.append("crash" if crashes.value else "ok")
+    lib.bochsref_destroy(vm)
+
+    for tc, o, n in zip(tcs, oracle, native):
+        assert o == n, f"verdict diverged on {tc.hex()}: oracle={o} native={n}"
