@@ -79,7 +79,8 @@ def test_chunk_ladder_reaches_cap():
 
 def test_one_oracle_lane_does_not_stall_the_ladder():
     """VERDICT r4 item 4: a single lane looping through oracle-class
-    instructions (x87 here) must not pin the whole batch to fine-grained
+    instructions (fxsave here — the x87 state movers are the remaining
+    oracle-serviced class) must not pin the whole batch to fine-grained
     chunks.  Chronic-lane servicing keeps the ladder growing and the lane
     rides the oracle burst; only broad events (decode misses, SMC,
     breakpoints) reset chunk size."""
@@ -92,20 +93,22 @@ def test_one_oracle_lane_does_not_stall_the_ladder():
     n_iters = 3000
     asm = f"""
         test rax, rax
-        jz x87_path
+        jz oracle_path
         mov ecx, {n_iters}
     int_loop:
         dec ecx
         jnz int_loop
         int3
-    x87_path:
+    oracle_path:
         mov rbx, {DATA_BASE}
-        mov ecx, 30
-    x87_loop:
         fld qword ptr [rbx]
-        fstp qword ptr [rbx+8]
+        mov ecx, 30
+    oracle_loop:
+        fxsave [rbx+0x200]
+        fxsave [rbx+0x400]
         dec ecx
-        jnz x87_loop
+        jnz oracle_loop
+        fstp qword ptr [rbx+8]
         int3
     """
     data = {DATA_BASE: struct.pack("<d", 2.5).ljust(0x1000, b"\x00")}

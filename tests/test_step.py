@@ -69,6 +69,14 @@ def assert_matches_oracle(asm, data=None, regs=None, n_lanes=2,
             assert int(xmm[lane, i, 1]) == emu.xmm[i][1], f"xmm{i} hi"
             assert int(xmm[lane, i, 2]) == emu.ymmh[i][0], f"ymm{i} up lo"
             assert int(xmm[lane, i, 3]) == emu.ymmh[i][1], f"ymm{i} up hi"
+        fpst = np.asarray(runner.machine.fpst)
+        for p in range(8):
+            assert int(fpst[lane, p]) == emu.fpst[p], (
+                f"lane {lane} fpst[{p}]: tpu={int(fpst[lane, p]):#x} "
+                f"emu={emu.fpst[p]:#x}")
+        assert int(runner.machine.fpsw[lane]) & 0xFFFF == emu.fpsw_packed()
+        assert int(runner.machine.fptw[lane]) & 0xFFFF == emu.fptw
+        assert int(runner.machine.fpcw[lane]) & 0xFFFF == emu.fpcw
     if check_mem:
         view = runner.view()
         for pfn in emu.mem.dirty_pfns():

@@ -1,0 +1,191 @@
+"""Device-executor differentials for the x87 subset (OPC_X87).
+
+Round 4 pinned the oracle's f64-value x87 model to the live host CPU
+(tests/test_x87.py); this file closes the loop for the DEVICE step the
+same way test_step_fp.py does for SSE: the hardware-pinned snippet grids
+re-run through `assert_matches_oracle`, which now compares the full
+fpst/fpsw/fptw/fpcw state as well.  Transitively:
+hardware == oracle == device.
+
+With this green, x87-touching lanes leave the per-instruction oracle
+round trip — only the FXSAVE-class state movers still divert.
+"""
+
+import struct
+
+import pytest
+
+from emurunner import DATA_BASE
+from test_step import assert_matches_oracle, make_runner
+from test_x87 import _EPILOGUE, _PRELUDE, F64
+
+
+def _dev(snippet, regs):
+    assert_matches_oracle(snippet + "\nhlt", regs=regs)
+
+
+ARITH_BODIES = [
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfaddp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfsubp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfsubrp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfmulp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfdivp st(1), st",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfdivrp st(1), st",
+    "fld qword ptr [rsp]\nfadd qword ptr [rsp+8]",
+    "fld qword ptr [rsp]\nfmul qword ptr [rsp+8]",
+    "fld qword ptr [rsp]\nfdiv qword ptr [rsp+8]",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfadd st, st(1)\n"
+    "fstp st(1)",
+    "fld qword ptr [rsp]\nfld qword ptr [rsp+8]\nfxch\nfsubp st(1), st",
+    "fld qword ptr [rsp]\nfchs",
+    "fld qword ptr [rsp]\nfabs",
+    "fld1\nfld qword ptr [rsp]\nfaddp st(1), st",
+    "fldz\nfld qword ptr [rsp]\nfsubp st(1), st",
+]
+
+
+@pytest.mark.parametrize("body", ARITH_BODIES)
+@pytest.mark.parametrize("a_name,b_name", [
+    ("one5", "two25"), ("pi", "e"), ("big", "tiny"),
+    ("pinf", "ninf"), ("qnan", "one5"), ("denorm", "denorm"),
+])
+def test_x87_arith_device_vs_oracle(body, a_name, b_name):
+    snippet = (_PRELUDE + body
+               + "\nfstp qword ptr [rsp+16]\nmov rax, [rsp+16]"
+               + _EPILOGUE)
+    _dev(snippet, {"rax": F64[a_name], "rcx": F64[b_name]})
+
+
+@pytest.mark.parametrize("ival", [0, 1, -1 & (1 << 64) - 1, 123456789,
+                                  0xFFFFFFFF00000000, 1 << 52])
+@pytest.mark.parametrize("width", ["word", "dword", "qword"])
+def test_fild_fistp_device_vs_oracle(ival, width):
+    snippet = (_PRELUDE
+               + f"fild qword ptr [rsp]\nfistp {width} ptr [rsp+16]\n"
+               + "mov rax, [rsp+16]" + _EPILOGUE)
+    _dev(snippet, {"rax": ival})
+
+
+@pytest.mark.parametrize("rc", [0, 1, 2, 3])
+def test_fist_rounding_modes_device_vs_oracle(rc):
+    """fist honors fpcw.RC; fisttp always chops."""
+    cw = 0x27F | (rc << 10)
+    snippet = f"""
+        sub rsp, 40
+        mov word ptr [rsp+34], {cw}
+        fldcw [rsp+34]
+        mov [rsp], rax
+        fld qword ptr [rsp]
+        fist dword ptr [rsp+16]
+        fisttp qword ptr [rsp+24]
+        mov rax, [rsp+16]
+        mov rcx, [rsp+24]
+        add rsp, 40
+    """
+    _dev(snippet, {"rax": 0xC002_4CCC_CCCC_CCCD})   # -2.2875
+
+
+@pytest.mark.parametrize("a_name,b_name", [
+    ("one5", "two25"), ("two25", "one5"), ("one5", "one5"),
+    ("qnan", "one5"), ("pinf", "big"),
+])
+def test_fcomi_fnstsw_device_vs_oracle(a_name, b_name):
+    snippet = (_PRELUDE + """
+    fld qword ptr [rsp+8]
+    fld qword ptr [rsp]
+    fcomip st, st(1)
+    pushfq
+    pop r8
+    fstp st(0)
+    fld qword ptr [rsp+8]
+    fld qword ptr [rsp]
+    fucompp
+    fnstsw ax
+    movzx rdx, ax
+""" + _EPILOGUE)
+    _dev(snippet, {"rax": F64[a_name], "rcx": F64[b_name]})
+
+
+def test_x87_control_ops_device_vs_oracle():
+    snippet = """
+        sub rsp, 48
+        fninit
+        fnstcw [rsp]
+        fld1
+        fldz
+        ffree st(1)
+        fnclex
+        fnstsw [rsp+8]
+        emms
+        fnstcw [rsp+16]
+        stmxcsr [rsp+24]
+        ldmxcsr [rsp+24]
+        mov rax, [rsp]
+        mov rcx, [rsp+8]
+        mov rdx, [rsp+16]
+        add rsp, 48
+    """
+    _dev(snippet, {})
+
+
+def test_fst_m32_and_fld_m32_device_vs_oracle():
+    data = struct.pack("<f", 1.75) + struct.pack("<f", -0.375)
+    assert_matches_oracle(f"""
+        mov rbx, {DATA_BASE}
+        fld dword ptr [rbx]
+        fadd dword ptr [rbx+4]
+        fst dword ptr [rbx+8]
+        fstp qword ptr [rbx+16]
+        hlt""", data={DATA_BASE: data.ljust(0x1000, b"\x00")})
+
+
+@pytest.mark.parametrize("op", ["fsubrp st(1), st", "fdivrp st(1), st",
+                                "fsubp st(1), st", "fdivp st(1), st"])
+def test_x87_two_nan_payload_routing(op):
+    """Reversed arith (fsubr/fdivr: b OP a) propagates the FIRST operand
+    of the OPERATION's NaN — st's payload for the reversed-p forms — so
+    two distinct NaNs must route exactly like the oracle (review fix)."""
+    snippet = (_PRELUDE
+               + f"fld qword ptr [rsp]\nfld qword ptr [rsp+8]\n{op}\n"
+               + "fstp qword ptr [rsp+16]\nmov rax, [rsp+16]" + _EPILOGUE)
+    _dev(snippet, {"rax": 0x7FF8000000000001, "rcx": 0x7FF8000000000002})
+
+
+def test_x87_m32_denormal_operand_diverts():
+    """An m32 arith operand in the f32 denormal range must divert to the
+    oracle (DAZ in the widening would flush it before the f64-level
+    check could see it — review fix).  On the CPU backend results match
+    either way; the assertion is that the divert HAPPENED."""
+    data = struct.pack("<I", 0x00000001) + struct.pack("<d", 1.0)
+    runner = make_runner(f"""
+        mov rbx, {DATA_BASE}
+        fld qword ptr [rbx+4]
+        fmul dword ptr [rbx]
+        fstp qword ptr [rbx+16]
+        hlt""", data={DATA_BASE: data.ljust(0x1000, b"\x00")}, n_lanes=2)
+    runner.run()
+    assert runner.stats["fallbacks"] >= 2  # both lanes diverted on fmul
+
+
+def test_x87_loop_no_fallback():
+    """An x87 compute loop must run with ZERO oracle round trips now —
+    the round-4 situation (every x87 insn a per-lane host single-step)
+    is the regression this guards."""
+    data = struct.pack("<dd", 100.0, 1.0625).ljust(0x1000, b"\x00")
+    runner = make_runner(f"""
+        mov rbx, {DATA_BASE}
+        fld qword ptr [rbx]
+        mov ecx, 40
+    top:
+        fmul qword ptr [rbx+8]
+        fld1
+        faddp st(1), st
+        dec ecx
+        jnz top
+        fstp qword ptr [rbx+16]
+        hlt""", data={DATA_BASE: data}, n_lanes=4)
+    status = runner.run()
+    from wtf_tpu.core.results import StatusCode
+
+    assert all(StatusCode(int(s)) == StatusCode.CRASH for s in status)
+    assert runner.stats["fallbacks"] == 0, runner.stats

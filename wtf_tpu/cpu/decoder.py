@@ -1021,7 +1021,9 @@ def _decode_0f(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
                        2: X87_LDMXCSR, 3: X87_STMXCSR,
                        4: X87_XSAVE, 5: X87_XRSTOR}[sub]
             _apply_mem(uop, modrm, pfx)
-            uop.src_kind = K_MEM  # address carrier; width handled in exec
+            uop.src_kind = K_MEM  # address carrier
+            if sub in (2, 3):
+                uop.srcsize = 4  # mxcsr dword (device load/store width)
         else:
             uop.opc = OPC_INVALID  # clflush/clwb out of subset
         return
@@ -1103,7 +1105,8 @@ def _decode_0f(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
 
 
 def _decode_x87(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
-    """x87 escape block D8-DF (OPC_X87, oracle-serviced).
+    """x87 escape block D8-DF (OPC_X87; executes on the device except
+    the FXSAVE-class state movers, interp/step.py).
 
     Covers the load/store/arith/compare/control subset MSVC and CRT
     helpers emit around `long double` and legacy math paths; the

@@ -94,12 +94,15 @@ OPC_SSEFP = 49     # SSE/SSE2 floating point (sub FP_*; srcsize = element
                    # (tools/decode_census.py); oracle-serviced — guests in
                    # the snapshot-fuzzing domain run integer-heavy paths,
                    # so FP trapping to the host costs little
-OPC_X87 = 50       # x87 FPU subset (sub X87_*; oracle-serviced).  Values
-                   # held in double precision — Windows runs the FPU with
-                   # PC=53-bit (fpcw 0x27F), where add/sub/mul/div round
-                   # identically to f64, so the model is bit-exact for the
-                   # codegen that actually appears; 80-bit-extended
-                   # corner cases (PC=64 + huge exponents) diverge
+OPC_X87 = 50       # x87 FPU subset (sub X87_*).  Values held in double
+                   # precision — Windows runs the FPU with PC=53-bit
+                   # (fpcw 0x27F), where add/sub/mul/div round
+                   # identically to f64, so the model is bit-exact for
+                   # the codegen that actually appears; 80-bit-extended
+                   # corner cases (PC=64 + huge exponents) diverge.
+                   # Executes on the DEVICE except the FXSAVE-class
+                   # state movers (512+ byte images), which stay
+                   # oracle-serviced
 
 N_OPC = 51
 

@@ -713,8 +713,12 @@ class Runner:
     # executable instruction ends the burst so its coverage/edge bits
     # land through the normal device path.
     _ORACLE_OPCS = frozenset((
-        U.OPC_X87, U.OPC_MSR, U.OPC_SSECVT, U.OPC_PEXT, U.OPC_PCLMUL,
+        U.OPC_MSR, U.OPC_SSECVT, U.OPC_PEXT, U.OPC_PCLMUL,
         U.OPC_STACKSTR, U.OPC_IRET,
+    ))
+    # x87 executes on-device except the state movers
+    _X87_ORACLE_SUBS = frozenset((
+        U.X87_FXSAVE, U.X87_FXRSTOR, U.X87_XSAVE, U.X87_XRSTOR,
     ))
 
     def _oracle_entry_at(self, view: HostView, lane: int,
@@ -738,7 +742,9 @@ class Runner:
                 pfn1 = pfn0
             self.cache.add(rip, uop, pfn0, pfn1)
         if (uop.opc in self._ORACLE_OPCS
-                or (uop.opc == U.OPC_LEAVE and uop.sub == 1)):  # enter
+                or (uop.opc == U.OPC_LEAVE and uop.sub == 1)  # enter
+                or (uop.opc == U.OPC_X87
+                    and uop.sub in self._X87_ORACLE_SUBS)):
             return self.cache.index[rip]
         return None
 

@@ -392,6 +392,18 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     is_leave = is_(U.OPC_LEAVE)
     is_sse = is_(U.OPC_SSEMOV) | is_(U.OPC_SSEALU)
     is_ssefp = is_(U.OPC_SSEFP)
+    is_x87 = is_(U.OPC_X87)
+    # x87 state save/restore (512+ byte images) stays oracle-serviced;
+    # everything else in the decoded x87 subset executes below
+    x87_oracle = is_x87 & (
+        (sub == U.X87_FXSAVE) | (sub == U.X87_FXRSTOR)
+        | (sub == U.X87_XSAVE) | (sub == U.X87_XRSTOR))
+    # store-shaped x87 subs must not issue the l1 read (their fault is a
+    # WRITE fault, like the MOV/SETCC/POP store_only set)
+    x87_store = is_x87 & (
+        (sub == U.X87_FST_M) | (sub == U.X87_FIST) | (sub == U.X87_FIST_T)
+        | (sub == U.X87_FNSTCW) | (sub == U.X87_FNSTSW_M)
+        | (sub == U.X87_STMXCSR))
     # SSE-FP memory-operand byte counts mirror the oracle's virt_read sizes
     # exactly (emu._exec_ssefp) so page-boundary fault behavior matches:
     # elementwise forms read 16 (packed) / elem; converts have their own
@@ -421,7 +433,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         is_(U.OPC_INVALID) | is_(U.OPC_IRET) | is_(U.OPC_MSR)
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
         | is_(U.OPC_STACKSTR)
-        | is_(U.OPC_X87)
+        | x87_oracle
         | (is_(U.OPC_LEAVE) & (sub == 1))  # enter: oracle-serviced
         # pinsrw m16: a 2-byte load outside the 16-byte operand window
         | (is_(U.OPC_SSEALU) & (sub == U.SSE_PINSRW) & (sk == U.K_MEM))
@@ -458,8 +470,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     srcsize = jnp.where(srcsize0 == 0, opsize, srcsize0)
 
     l1_need = pre_live & ~unsupported & ~rep_skip & (
-        (sk == U.K_MEM) | is_pop | is_popf | is_ret | is_leave
-        | s_movs | s_lods | s_cmps | s_scas)
+        ((sk == U.K_MEM) & ~x87_store) | is_pop | is_popf | is_ret
+        | is_leave | s_movs | s_lods | s_cmps | s_scas)
     l1_addr = jnp.where(s_movs | s_lods | s_cmps, rsi,
                jnp.where(s_scas, rdi,
                 jnp.where(is_pop | is_popf | is_ret, rsp,
@@ -484,7 +496,10 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
         (s_movs | s_stos, rdi),
     ], ea)
-    st_size = push_size  # stores and pushes span the same byte count
+    # stores and pushes span the same byte count; x87 stores their
+    # operand width (fst m32/m64, fist m16/32/64, fnstcw/fnstsw m16,
+    # stmxcsr m32)
+    st_size = jnp.where(x87_store, srcsize, push_size)
 
     # -- 4b'. ONE vectorized page walk for all six translations, ONE
     # batched gather for all three 16-byte windows (code/SMC, l1, l2).
@@ -1290,6 +1305,210 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     fp_out_hi = jnp.where(fp_wsz >= 16, fp_res_hi, x_dst_hi)
     fp_writes_xmm = is_ssefp & ~fp_is_f2i & ~fp_is_comi
 
+    # -- x87 (OPC_X87) device execution -----------------------------------
+    # The same f64-value model as the oracle (emu._exec_x87; bit-exact vs
+    # hardware under Windows' PC=53 control word): the register stack is
+    # fpst[8] physical slots with TOP in fpsw bits 11-13, values are f64
+    # bits, and arithmetic rides the same NaN-routing helpers as the SSE
+    # block (dst-NaN-wins, quieting, real-indefinite).  FXSAVE-class
+    # state movers stay oracle-serviced (x87_oracle above); denormal-
+    # touching lanes divert like SSE-FP lanes do.
+    fpst_v, fpcw_v, fpsw_v, fptw_v = st.fpst, st.fpcw, st.fpsw, st.fptw
+    x_top = (fpsw_v >> _u(11)) & _u(7)
+    x_i = imm & _u(7)
+
+    def _xphys(k):
+        return ((x_top + k) & _u(7)).astype(jnp.int32)
+
+    x_ph0 = _xphys(_u(0))
+    x_phi = _xphys(x_i)
+    st0_b = fpst_v[x_ph0]
+    sti_b = fpst_v[x_phi]
+    st0_f = lax.bitcast_convert_type(st0_b, jnp.float64)
+
+    # memory operand -> f64 bits: m64 is a raw bit move, m32 converts
+    # with the NaN-safe widening, integers convert exactly like the
+    # oracle's int64 -> float64
+    xm32_u = (l1_lo & _m32).astype(jnp.uint32)
+    xm32_f = lax.bitcast_convert_type(xm32_u, jnp.float32)
+    x_mem_b = jnp.where(srcsize0 >= 8, l1_lo,
+                        _cvt_s2d(xm32_u, xm32_f, _nan32(xm32_u)))
+    x_fild_b = _b64(_sext(l1_lo, srcsize0).astype(jnp.int64)
+                    .astype(jnp.float64))
+
+    # arithmetic (ADD/MUL/SUB/SUBR/DIV/DIVR by the encoded digit; COM/
+    # COMP digits compare instead)
+    x_arith_m = sub == U.X87_ARITH_M
+    x_arith_st = sub == U.X87_ARITH_ST
+    x_dsti = x_arith_st & (dr == 1)       # DC/DE: st(i) is the destination
+    xa_b = jnp.where(x_dsti, sti_b, st0_b)
+    xb_b = jnp.where(x_arith_m, x_mem_b,
+                     jnp.where(x_dsti, st0_b, sti_b))
+    xa_f = lax.bitcast_convert_type(xa_b, jnp.float64)
+    xb_f = lax.bitcast_convert_type(xb_b, jnp.float64)
+    x_r = jnp.select(
+        [cond == U.X87_OP_ADD, cond == U.X87_OP_MUL,
+         cond == U.X87_OP_SUB, cond == U.X87_OP_SUBR,
+         cond == U.X87_OP_DIV],
+        [xa_f + xb_f, xa_f * xb_f, xa_f - xb_f, xb_f - xa_f, xa_f / xb_f],
+        default=xb_f / xa_f)  # X87_OP_DIVR
+    x_r_b = _b64(x_r)
+    nan_xa, nan_xb = _nan64(xa_b), _nan64(xb_b)
+    # NaN routing follows the OPERATION's operand order: hardware
+    # propagates the first source operand's NaN, and for the reversed
+    # forms (fsubr/fdivr: b OP a) that is xb — matching the oracle's
+    # `bn - an` / `bn / an`
+    x_rev = (cond == U.X87_OP_SUBR) | (cond == U.X87_OP_DIVR)
+    x_n1 = jnp.where(x_rev, nan_xb, nan_xa)
+    x_n1_b = jnp.where(x_rev, xb_b, xa_b)
+    x_n2 = jnp.where(x_rev, nan_xa, nan_xb)
+    x_n2_b = jnp.where(x_rev, xa_b, xb_b)
+    x_arith_out = jnp.where(
+        x_n1, x_n1_b | _QBIT64,
+        jnp.where(x_n2, x_n2_b | _QBIT64,
+                  jnp.where(_nan64(x_r_b), _INDEF64, x_r_b)))
+    x_is_com_digit = (cond == U.X87_OP_COM) | (cond == U.X87_OP_COMP)
+    x_arith_writes = (x_arith_m | x_arith_st) & ~x_is_com_digit
+
+    # compares: fcom/fucom (C3/C2/C0 in the status word), fcomi/fucomi
+    # (ZF/PF/CF in rflags) — same unordered rules as ucomis
+    x_cmp_b = jnp.where(x_arith_m, x_mem_b, sti_b)
+    x_cmp_bf = lax.bitcast_convert_type(x_cmp_b, jnp.float64)
+    x_unord = _nan64(st0_b) | _nan64(x_cmp_b)
+    x_eq = st0_f == x_cmp_bf
+    x_lt = st0_f < x_cmp_bf
+    x87_comi_rf = (rf & ~_u(FLAGS_ARITH)) | _mkflags(
+        x_unord | (~x_unord & x_lt), x_unord, jnp.bool_(False),
+        x_unord | (~x_unord & x_eq), jnp.bool_(False), jnp.bool_(False))
+    x_com_bits = (jnp.where(x_unord | (~x_unord & x_eq), _u(0x4000), _u(0))
+                  | jnp.where(x_unord, _u(0x400), _u(0))
+                  | jnp.where(x_unord | (~x_unord & x_lt), _u(0x100), _u(0)))
+    x_is_com = is_x87 & ((sub == U.X87_COM)
+                         | ((x_arith_m | x_arith_st) & x_is_com_digit))
+
+    # fist(p)/fisttp: fpcw.RC rounding (fisttp always chops), integer
+    # indefinite on NaN/overflow — the oracle's _exec_x87 FIST logic
+    x_rc = jnp.where(sub == U.X87_FIST_T, _u(3), (fpcw_v >> _u(10)) & _u(3))
+    x_bits_n = srcsize0 * 8
+    x_limit = jnp.exp2((x_bits_n - 1).astype(jnp.float64))
+    x_round = jnp.select(
+        [x_rc == _u(0), x_rc == _u(1), x_rc == _u(2)],
+        [lax.round(st0_f, lax.RoundingMethod.TO_NEAREST_EVEN),
+         jnp.floor(st0_f), jnp.ceil(st0_f)],
+        default=jnp.trunc(st0_f))
+    x_fist_bad = _nan64(st0_b) | (x_round >= x_limit) | (x_round < -x_limit)
+    x_fist_safe = jnp.clip(x_round, -x_limit, x_limit - 1)
+    x_fist_val = jnp.where(
+        x_fist_bad,
+        _shl(_u(1), (x_bits_n - 1).astype(jnp.uint64)),
+        x_fist_safe.astype(jnp.int64).astype(jnp.uint64)
+        ) & _size_mask(srcsize0)
+
+    # fst m32: NaN-safe narrowing of st0
+    x_fst32 = _cvt_d2s(st0_b, st0_f, _nan64(st0_b)).astype(jnp.uint64)
+    x87_store_val = jnp.select(
+        [sub == U.X87_FST_M,
+         (sub == U.X87_FIST) | (sub == U.X87_FIST_T),
+         sub == U.X87_FNSTCW,
+         sub == U.X87_FNSTSW_M],
+        [jnp.where(srcsize0 >= 8, st0_b, x_fst32),
+         x_fist_val, fpcw_v & _u(0xFFFF), fpsw_v & _u(0xFFFF)],
+        default=st.mxcsr & _u(0xFFFFFFFF))  # STMXCSR
+
+    # pushes
+    x_is_push = is_x87 & (
+        (sub == U.X87_FLD_M) | (sub == U.X87_FILD)
+        | (sub == U.X87_FLD_STI) | (sub == U.X87_FLD_CONST))
+    x_push_b = jnp.select(
+        [sub == U.X87_FLD_M, sub == U.X87_FILD, sub == U.X87_FLD_STI],
+        [x_mem_b, x_fild_b, sti_b],
+        default=jnp.where(imm == _u(0), _u(0x3FF0000000000000), _u(0)))
+    x_push_slot = ((x_top - _u(1)) & _u(7)).astype(jnp.int32)
+
+    # register-stack writes: one generic write + the FXCH partner write
+    x_fxch = sub == U.X87_FXCH
+    x_w1_en = is_x87 & (
+        x_is_push | x_arith_writes | (sub == U.X87_FST_STI)
+        | (sub == U.X87_FCHS) | (sub == U.X87_FABS) | x_fxch)
+    x_w1_idx = jnp.select(
+        [x_is_push, x_arith_writes & x_dsti, sub == U.X87_FST_STI],
+        [x_push_slot, x_phi, x_phi], default=x_ph0)
+    x_w1_val = jnp.select(
+        [x_is_push, x_arith_writes, sub == U.X87_FST_STI,
+         sub == U.X87_FCHS, sub == U.X87_FABS],
+        [x_push_b, x_arith_out, st0_b,
+         st0_b ^ _u(1 << 63), st0_b & _u((1 << 63) - 1)],
+        default=sti_b)  # FXCH: st0 <- st(i)
+
+    # stack top / tag word / control+status words
+    x_pops = jnp.where(is_x87, sext_f, jnp.int32(0))
+    x_fninit = sub == U.X87_FNINIT
+    x_new_top = jnp.where(
+        x_fninit, _u(0),
+        jnp.where(x_is_push, (x_top - _u(1)) & _u(7),
+                  (x_top + x_pops.astype(jnp.uint64)) & _u(7)))
+
+    def _tag_set(tw, phys_i32, val):
+        sh = phys_i32.astype(jnp.uint64) * _u(2)
+        return (tw & ~(_u(3) << sh)) | (_u(val) << sh)
+
+    x_tw = fptw_v
+    x_tw = jnp.where(x_is_push, _tag_set(x_tw, x_push_slot, 0), x_tw)
+    x_tw = jnp.where(is_x87 & (sub == U.X87_FST_STI),
+                     _tag_set(x_tw, x_phi, 0), x_tw)
+    x_tw = jnp.where(is_x87 & (x_pops >= 1), _tag_set(x_tw, x_ph0, 3), x_tw)
+    x_tw = jnp.where(is_x87 & (x_pops >= 2),
+                     _tag_set(x_tw, _xphys(_u(1)), 3), x_tw)
+    x_tw = jnp.where(is_x87 & (sub == U.X87_FFREE),
+                     _tag_set(x_tw, x_phi, 3), x_tw)
+    x_tw = jnp.where(is_x87 & (x_fninit | (sub == U.X87_EMMS)),
+                     _u(0xFFFF), x_tw)
+
+    x_cw = jnp.where(is_x87 & (sub == U.X87_FLDCW), l1_lo & _u(0xFFFF),
+                     jnp.where(is_x87 & x_fninit, _u(0x37F), fpcw_v))
+    x_sw = fpsw_v
+    x_sw = jnp.where(x_is_com, (x_sw & ~_u(0x4500)) | x_com_bits, x_sw)
+    x_sw = jnp.where(is_x87 & (sub == U.X87_FNCLEX), x_sw & ~_u(0x80FF), x_sw)
+    x_sw = jnp.where(is_x87 & x_fninit, _u(0), x_sw)
+    x_sw = jnp.where(is_x87,
+                     (x_sw & ~_u(0x3800)) | (x_new_top << _u(11)), x_sw)
+
+    # denormal / FTZ risk -> oracle divert, same policy as the SSE block
+    x_r_zero = (x_r_b & _u(0x7FFFFFFFFFFFFFFF)) == _u(0)
+    x_true_zero = jnp.select(
+        [cond == U.X87_OP_ADD,
+         (cond == U.X87_OP_SUB) | (cond == U.X87_OP_SUBR),
+         cond == U.X87_OP_MUL],
+        [xa_f == -xb_f, xa_f == xb_f,
+         ((xa_b & _u(0x7FFFFFFFFFFFFFFF)) == _u(0))
+         | ((xb_b & _u(0x7FFFFFFFFFFFFFFF)) == _u(0))],
+        default=((jnp.where(cond == U.X87_OP_DIV, xa_b, xb_b)
+                  & _u(0x7FFFFFFFFFFFFFFF)) == _u(0))
+        | ((jnp.where(cond == U.X87_OP_DIV, xb_b, xa_b)
+            & _u(0x7FFFFFFFFFFFFFFF)) == _u(0x7FF0000000000000)))
+    x_ftz = (x_arith_m | x_arith_st) & ~x_is_com_digit \
+        & x_r_zero & ~x_true_zero & ~nan_xa & ~nan_xb
+    # an m32 arith operand needs the f32-level denormal check: DAZ in
+    # the widening flushes it before _den64 could ever see it (a
+    # converted f32 denormal is a NORMAL f64)
+    x_den_arith = (x_arith_m | x_arith_st) & (
+        _den64(xa_b) | _den64(xb_b)
+        | (x_arith_m & (srcsize0 < 8) & _den32(xm32_u)))
+    x_fst32_small = (((st0_b >> _u(52)) & _u(0x7FF)) <= _u(897)) \
+        & ((st0_b & _u(0x7FFFFFFFFFFFFFFF)) != _u(0))
+    x87_denorm = is_x87 & ~x87_oracle & jnp.select(
+        [x_arith_m | x_arith_st,
+         (sub == U.X87_FLD_M) & (srcsize0 < 8),
+         (sub == U.X87_FST_M) & (srcsize0 < 8),
+         (sub == U.X87_FIST) | (sub == U.X87_FIST_T),
+         (sub == U.X87_COM) | (sub == U.X87_COMI)],
+        [x_ftz | x_den_arith,
+         _den32(xm32_u),
+         x_fst32_small,
+         _den64(st0_b),
+         _den64(st0_b) | _den64(x_cmp_b)],
+        default=jnp.bool_(False))
+
     # -- 5. result routing -------------------------------------------------
     cc01 = jnp.where(cc_true, _u(1), _u(0))
     is_mul = is_(U.OPC_MUL)
@@ -1327,6 +1546,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_ssemov, (sub == 2) & (dk == U.K_REG)),
         (is_ssealu, (sub == U.SSE_PMOVMSKB) | (sub == U.SSE_PEXTRW)),
         (is_ssefp, fp_is_f2i),
+        (is_x87, sub == U.X87_FNSTSW_AX),
     ], jnp.bool_(False))
     w1_idx = opc_list([
         (is_mul, jnp.where(is_mul2, dr, i0)),
@@ -1368,6 +1588,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_ssemov, xmm[jnp.clip(sr, 0, 15), 0]),
         (is_ssealu, jnp.where(sub == U.SSE_PEXTRW, pextrw_val, pmov_mask)),
         (is_ssefp, f2i_val),
+        (is_x87, fpsw_v & _u(0xFFFF)),
     ], _u(0))
     w1_size = opc_list([
         (is_mul, jnp.where(is_mul2, opsize,
@@ -1376,6 +1597,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_leave | is_(U.OPC_RDTSC) | is_(U.OPC_SYSCALL)
          | is_(U.OPC_MOVCR), jnp.int32(8)),
         (is_(U.OPC_XGETBV) | is_ssealu, jnp.int32(4)),
+        (is_x87, jnp.int32(2)),  # fnstsw ax
     ], opsize)
 
     # secondary register write
@@ -1436,7 +1658,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     ], jnp.bool_(False))
     st_need = live & ~unsupported & ~rep_skip & (
         ((dk == U.K_MEM) & mem_class_writes)
-        | is_push | is_pushf | is_call | s_movs | s_stos)
+        | is_push | is_pushf | is_call | s_movs | s_stos | x87_store)
     st_lo = opc_list([
         (is_(U.OPC_MOV) | is_push, src_val),
         (is_(U.OPC_ALU), alu_r),
@@ -1456,6 +1678,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         # in the class stores from the low limb
         (is_ssemov, jnp.where(sub == 5, xmm[jnp.clip(sr, 0, 15), 1],
                               xmm[jnp.clip(sr, 0, 15), 0])),
+        (is_x87, x87_store_val),
     ], _u(0))
     st_hi = jnp.where(is_ssemov, xmm[jnp.clip(sr, 0, 15), 1],
                       jnp.where(s_movs, l1_hi, _u(0)))
@@ -1464,7 +1687,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     store_fault = st_need & ~(ts0.ok & ts1.ok & ts0.writable & ts1.writable)
 
     page_fault = live & ~unsupported & ~is_crash & (fault1 | fault2 | store_fault)
-    fp_oracle = live & ~unsupported & ~page_fault & fp_denorm
+    fp_oracle = live & ~unsupported & ~page_fault & (fp_denorm | x87_denorm)
     commit_pre = live & ~unsupported & ~is_crash & ~de & ~page_fault \
         & ~fp_oracle
 
@@ -1508,6 +1731,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_SYSCALL), jnp.where(syscall_entry, syscall_rf, sysret_rf)),
         (is_ssealu & (sub == U.SSE_PTEST), ptest_rf),
         (is_ssefp & fp_is_comi, ucomi_rf),
+        (is_x87 & (sub == U.X87_COMI), x87_comi_rf),
     ], rf)
     new_rf = jnp.where(commit, rf_exec | _u(0x2), rf)
 
@@ -1569,6 +1793,18 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
                                   jnp.zeros(4, bool)))
     new_xmm = jnp.where(vz_limb[None, :], _u(0), new_xmm)
 
+    # -- x87 state application --------------------------------------------
+    x87c = commit & is_x87
+    new_fpst = fpst_v.at[x_w1_idx].set(
+        jnp.where(x87c & x_w1_en, x_w1_val, fpst_v[x_w1_idx]))
+    new_fpst = new_fpst.at[x_phi].set(
+        jnp.where(x87c & x_fxch, st0_b, new_fpst[x_phi]))
+    new_fpcw = jnp.where(x87c, x_cw, fpcw_v)
+    new_fpsw = jnp.where(x87c, x_sw, fpsw_v)
+    new_fptw = jnp.where(x87c, x_tw, fptw_v)
+    new_mxcsr = jnp.where(x87c & (sub == U.X87_LDMXCSR),
+                          l1_lo & _u(0xFFFFFFFF), st.mxcsr)
+
     # -- bookkeeping -------------------------------------------------------
     new_icount = st.icount + jnp.where(commit, _u(1), _u(0))
     timed = commit & (limit > _u(0)) & (new_icount >= limit)
@@ -1628,6 +1864,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 
     return st._replace(
         gpr=new_gpr, rip=new_rip, rflags=new_rf, xmm=new_xmm,
+        fpst=new_fpst, fpcw=new_fpcw, fpsw=new_fpsw, fptw=new_fptw,
+        mxcsr=new_mxcsr,
         gs_base=new_gs, kernel_gs_base=new_kgs,
         cr0=new_cr0, cr3=new_cr3, cr4=new_cr4, cr8=new_cr8,
         cs=new_cs, ss=new_ss,
