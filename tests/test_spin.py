@@ -114,6 +114,7 @@ def test_one_oracle_lane_does_not_stall_the_ladder():
     data = {DATA_BASE: struct.pack("<d", 2.5).ljust(0x1000, b"\x00")}
     runner = make_runner(asm, data=data, n_lanes=4)
     runner._chunk_sizes = [64, 1024]  # CI-sized ladder (same code path)
+    runner.burst_any_tier = True      # exercise the full burst in CI
     view = runner.view()
     for lane in range(1, 4):
         view.set_reg(lane, 0, 1)  # integer path; lane 0 stays on x87
@@ -156,3 +157,7 @@ def test_one_oracle_lane_does_not_stall_the_ladder():
     finally:
         Runner._fallback_burst = orig_burst
     assert covered(slow, 0) == burst_cov
+    # edge-bitmap parity too: burst-stepped branches owe their edge-hash
+    # bits (_pending_edge) — lane 0 ran through the same control flow
+    assert np.array_equal(np.asarray(runner.machine.edge)[0],
+                          np.asarray(slow.machine.edge)[0])

@@ -211,6 +211,34 @@ DIFF_CASES = [
         skip2:
         skip1:
         hlt""", None),
+    ("msr_roundtrip", """
+        mov ecx, 0xC0000082
+        mov eax, 0x11223344
+        mov edx, 0x55667788
+        wrmsr
+        xor eax, eax
+        xor edx, edx
+        rdmsr
+        mov rbx, rax
+        mov rsi, rdx
+        mov ecx, 0xC0000101
+        rdmsr
+        mov r8, rax
+        mov ecx, 0xC0000080
+        rdmsr
+        hlt""", None),
+    ("wrmsr_lstar_steers_syscall", """
+        lea rax, [rip + handler]
+        mov rdx, rax
+        shr rdx, 32
+        mov ecx, 0xC0000082
+        wrmsr
+        syscall
+        mov rbx, 0xBAD
+        hlt
+    handler:
+        mov rbx, 0x600D
+        hlt""", None),
     ("jecxz_a32", """
         mov rcx, 0xF00000000
         jecxz taken

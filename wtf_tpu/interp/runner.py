@@ -497,9 +497,16 @@ class Runner:
         self._chunk_level = 0
         # consecutive service rounds per lane (oracle burst sizing)
         self._fallback_streak: Dict[int, int] = {}
-        # (lane, uop-entry) coverage bits owed by oracle burst steps;
-        # OR-ed into machine.cov at the next push
+        # The burst's any-instruction tier amortizes EXPENSIVE dispatch
+        # round trips (a real chip, possibly behind a tunnel); on the CPU
+        # platform a dispatch is ~free and the device executes glue
+        # instructions faster than the Python oracle, so the tier is off.
+        self.burst_any_tier = jax.default_backend() != "cpu"
+        # (lane, uop-entry) coverage bits and (lane, edge-index) edge bits
+        # owed by oracle burst steps; OR-ed into the device bitmaps at
+        # the next push
         self._pending_cov: List[Tuple[int, int]] = []
+        self._pending_edge: List[Tuple[int, int]] = []
         # run statistics (reference PrintRunStats role, backend.h:218)
         self.stats = {
             "chunks": 0, "decodes": 0, "decodes_prefetched": 0,
@@ -519,20 +526,26 @@ class Runner:
             name: jnp.asarray(view.r[name]) for name in _MIRROR_FIELDS
         }
         self.machine = self.machine._replace(**updates)
-        if self._pending_cov:
+        def _apply_bits(bitmap, pending):
             # combine host-side to unique (lane, word) pairs so the
             # device read-modify-write scatter is deterministic
             acc: Dict[Tuple[int, int], int] = {}
-            for lane, idx in self._pending_cov:
-                key = (lane, idx >> 5)
-                acc[key] = acc.get(key, 0) | (1 << (idx & 31))
+            for lane, bit in pending:
+                key = (lane, bit >> 5)
+                acc[key] = acc.get(key, 0) | (1 << (bit & 31))
             lanes = jnp.asarray([k[0] for k in acc], dtype=jnp.int32)
             words = jnp.asarray([k[1] for k in acc], dtype=jnp.int32)
             bits = jnp.asarray(list(acc.values()), dtype=jnp.uint32)
-            cov = self.machine.cov
-            cov = cov.at[lanes, words].set(cov[lanes, words] | bits)
-            self.machine = self.machine._replace(cov=cov)
+            return bitmap.at[lanes, words].set(bitmap[lanes, words] | bits)
+
+        if self._pending_cov:
+            self.machine = self.machine._replace(
+                cov=_apply_bits(self.machine.cov, self._pending_cov))
             self._pending_cov.clear()
+        if self._pending_edge:
+            self.machine = self.machine._replace(
+                edge=_apply_bits(self.machine.edge, self._pending_edge))
+            self._pending_edge.clear()
         if view.pending:
             items = sorted(view.pending.items())
             k = len(items)
@@ -713,18 +726,19 @@ class Runner:
     # executable instruction ends the burst so its coverage/edge bits
     # land through the normal device path.
     _ORACLE_OPCS = frozenset((
-        U.OPC_MSR, U.OPC_SSECVT, U.OPC_PEXT, U.OPC_PCLMUL,
-        U.OPC_STACKSTR, U.OPC_IRET,
+        U.OPC_SSECVT, U.OPC_PCLMUL, U.OPC_STACKSTR, U.OPC_IRET,
     ))
     # x87 executes on-device except the state movers
     _X87_ORACLE_SUBS = frozenset((
         U.X87_FXSAVE, U.X87_FXRSTOR, U.X87_XSAVE, U.X87_XRSTOR,
     ))
 
-    def _oracle_entry_at(self, view: HostView, lane: int,
-                         rip: int) -> Optional[int]:
-        """Uop-table entry index at `rip` when it decodes to an
-        oracle-class instruction (publishing it on a miss), else None."""
+    _BRANCH_OPCS = frozenset((U.OPC_JMP, U.OPC_JCC, U.OPC_CALL, U.OPC_RET))
+
+    def _entry_at(self, view: HostView, lane: int,
+                  rip: int) -> Optional[Tuple[int, "U.Uop"]]:
+        """(uop-table entry index, uop) at `rip`, publishing the decode on
+        a miss; None when the bytes can't be fetched or don't decode."""
         uop = self.cache.uops.get(rip)
         if uop is None:
             try:
@@ -741,38 +755,63 @@ class Runner:
             except HostFault:
                 pfn1 = pfn0
             self.cache.add(rip, uop, pfn0, pfn1)
-        if (uop.opc in self._ORACLE_OPCS
+        return self.cache.index[rip], uop
+
+    def _is_oracle_uop(self, uop) -> bool:
+        return (uop.opc in self._ORACLE_OPCS
                 or (uop.opc == U.OPC_LEAVE and uop.sub == 1)  # enter
                 or (uop.opc == U.OPC_X87
-                    and uop.sub in self._X87_ORACLE_SUBS)):
-            return self.cache.index[rip]
-        return None
+                    and uop.sub in self._X87_ORACLE_SUBS))
 
     def _fallback_burst(self, view: HostView, lane: int) -> None:
         """Service an UNSUPPORTED lane; when the lane has needed the oracle
-        for consecutive rounds (an x87/MSR-dense region), keep stepping it
-        host-side through further oracle-class instructions so its progress
+        for consecutive rounds, keep stepping it host-side so its progress
         per round grows instead of staying one-instruction-per-chunk.
-        Stops at armed breakpoints (the device checks them pre-execution)
-        and at the first device-executable instruction."""
+
+        Two burst tiers: a short streak runs ahead through further
+        oracle-class instructions only; a chronic streak (>= 4 rounds —
+        e.g. a lane crunching denormal-range FP where every arith op
+        diverts) runs ahead through ANY instruction.  Coverage parity is
+        preserved both ways: every burst-stepped rip's coverage bit and
+        every branch's edge-hash bit are recorded host-side
+        (_pending_cov/_pending_edge) and OR-ed into the device bitmaps at
+        the next push.  Stops at armed breakpoints (the device checks
+        them pre-execution) and on any terminal/fault status."""
         self._fallback_step(view, lane)
         streak = self._fallback_streak.get(lane, 0) + 1
         self._fallback_streak[lane] = streak
         if streak < 2:
             return
         budget = min(32 << min(streak, 6), 1024)
+        # The any-instruction tier is kept SHORT: it exists to carry a
+        # chronic lane across the device-class glue between diverting
+        # instructions (denormal FP every few ops), not to steal long
+        # normal stretches from the device, which executes them faster.
+        any_budget = 24 if (streak >= 4 and self.burst_any_tier) else 0
+        ebits = self.machine.edge.shape[1] * 32
+        from wtf_tpu.utils.hashing import mix64
+
         while budget > 0:
             if view.get_status(lane) != StatusCode.RUNNING:
                 return
             rip = view.get_rip(lane)
             if self.cache.has_breakpoint(rip):
                 return
-            idx = self._oracle_entry_at(view, lane, rip)
-            if idx is None:
+            entry = self._entry_at(view, lane, rip)
+            if entry is None:
                 return
+            idx, uop = entry
+            if not self._is_oracle_uop(uop):
+                if any_budget <= 0:
+                    return
+                any_budget -= 1
             self._fallback_step(view, lane)
-            # the coverage bit the device dispatch would have recorded
+            # the coverage/edge bits the device dispatch would have set
             self._pending_cov.append((lane, idx))
+            if (uop.opc in self._BRANCH_OPCS
+                    and view.get_status(lane) == StatusCode.RUNNING):
+                eh = mix64(rip) ^ view.get_rip(lane)
+                self._pending_edge.append((lane, eh & (ebits - 1)))
             self.stats["fallback_burst_steps"] += 1
             budget -= 1
 
@@ -920,6 +959,7 @@ class Runner:
         self.machine = machine_restore(self.machine, self.template)
         self.lane_errors.clear()
         self._pending_cov.clear()
+        self._pending_edge.clear()
         # per-testcase SMC thrash window: a rip legitimately rewritten many
         # times within ONE run falls back to the oracle, but the count must
         # not accumulate across the campaign (fresh-run behavior parity)

@@ -39,6 +39,7 @@ import numpy as np
 
 from wtf_tpu.core.results import StatusCode
 from wtf_tpu.cpu import uops as U
+from wtf_tpu.cpu.emu import MSR_ATTR
 from wtf_tpu.cpu.cpuid import CPUID_TABLE, MAX_BASIC_LEAF
 from wtf_tpu.interp.machine import Machine
 from wtf_tpu.interp.uoptable import (
@@ -423,6 +424,13 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 
     # -- unsupported classes -> host oracle fallback ----------------------
     rax, rdx = gpr[0], gpr[2]
+    # MSRs the machine carries — derived from the oracle's MSR_ATTR map
+    # (single source of truth; attr names are Machine field names);
+    # unknown ids stay oracle-serviced
+    msr_id = gpr[1] & _u(0xFFFFFFFF)
+    msr_known = jnp.zeros((), bool)
+    for _mid in MSR_ATTR:
+        msr_known = msr_known | (msr_id == _u(_mid))
     div64_hard = is_(U.OPC_DIV) & (opsize >= 8) & ~jnp.where(
         sub == U.DIV_U, rdx == _u(0),
         rdx == jnp.where((rax >> _u(63)) != 0, _u(MASK64), _u(0)))
@@ -430,8 +438,9 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (sub == 0) | (sub == 3) | (sub == 4) | (sub == 8)
         | ((sext_f == 0) & (sub == 2)))
     unsupported = pre_live & (
-        is_(U.OPC_INVALID) | is_(U.OPC_IRET) | is_(U.OPC_MSR)
-        | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL) | is_(U.OPC_PEXT)
+        is_(U.OPC_INVALID) | is_(U.OPC_IRET)
+        | (is_(U.OPC_MSR) & ~msr_known)
+        | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL)
         | is_(U.OPC_STACKSTR)
         | x87_oracle
         | (is_(U.OPC_LEAVE) & (sub == 1))  # enter: oracle-serviced
@@ -815,6 +824,81 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
          | jnp.where(bs_zero, _u(_CF), _u(0))
          | jnp.where(bs_r == _u(0), _u(_ZF), _u(0))],
         default=(rf & ~_u(_ZF)) | jnp.where(bs_zero, _u(_ZF), _u(0)))
+
+    # BMI1/BMI2 (OPC_PEXT): VEX scalar bit ops; the third operand
+    # (VEX.vvvv) rides in `cond` per the decoder's convention ----------
+    bmi_third = _read_reg(gpr, cond, opsize)
+    bmi_src = src_val & opmask
+    bmi_n8 = bmi_third & _u(0xFF)
+    bzhi_keep = bmi_n8 >= bits_u
+    bmi_bzhi = jnp.where(bzhi_keep, bmi_src,
+                         bmi_src & (_shl(_u(1), bmi_n8) - _u(1)))
+    bx_start = bmi_third & _u(0xFF)
+    bx_len = (bmi_third >> _u(8)) & _u(0xFF)
+    bx_mask = _shl(_u(1), bx_len) - _u(1)   # len >= 64 wraps to all-ones
+    bmi_bextr = jnp.where(bx_start < bits_u,
+                          _shr(bmi_src, bx_start) & bx_mask, _u(0)) & opmask
+    bmi_cnt = bmi_third & jnp.where(opsize >= 8, _u(63), _u(31))
+    bmi_shlx = _shl(bmi_src, bmi_cnt) & opmask
+    bmi_shrx = _shr(bmi_src, bmi_cnt)
+    bmi_sarx = (_sext(bmi_src, opsize).astype(jnp.int64)
+                >> jnp.minimum(bmi_cnt, _u(63)).astype(jnp.int64)
+                ).astype(jnp.uint64) & opmask
+    # pdep/pext: rank-based bit scatter/gather over 64 lanes
+    bit_i = jnp.arange(64, dtype=jnp.uint64)
+    src_bit = (bmi_src >> bit_i) & _u(1)
+    bit_rank = jnp.cumsum(src_bit) - src_bit    # exclusive prefix count
+    bmi_pext = jnp.sum(jnp.where(src_bit != 0,
+                                 ((bmi_third >> bit_i) & _u(1)) << bit_rank,
+                                 _u(0)))
+    bmi_pdep = jnp.sum(jnp.where(src_bit != 0,
+                                 ((bmi_third >> bit_rank) & _u(1)) << bit_i,
+                                 _u(0)))
+    bmi_blsr = bmi_src & (bmi_src - _u(1)) & opmask
+    bmi_blsmsk = (bmi_src ^ (bmi_src - _u(1))) & opmask
+    bmi_blsi = bmi_src & ((_u(0) - bmi_src) & opmask) & opmask
+    rorx_n = imm & jnp.where(opsize >= 8, _u(63), _u(31))
+    bmi_rorx = jnp.where(
+        rorx_n == _u(0), bmi_src,
+        (_shr(bmi_src, rorx_n) | _shl(bmi_src, bits_u - rorx_n)) & opmask)
+    bmi_andn = (~bmi_third & bmi_src) & opmask
+    bmi_res = jnp.select(
+        [sub == U.BMI_ANDN, sub == U.BMI_BZHI, sub == U.BMI_BEXTR,
+         sub == U.BMI_SHLX, sub == U.BMI_SHRX, sub == U.BMI_SARX,
+         sub == U.BMI_PDEP, sub == U.BMI_PEXT_, sub == U.BMI_BLSR,
+         sub == U.BMI_BLSMSK, sub == U.BMI_BLSI],
+        [bmi_andn, bmi_bzhi, bmi_bextr, bmi_shlx, bmi_shrx, bmi_sarx,
+         bmi_pdep, bmi_pext, bmi_blsr, bmi_blsmsk, bmi_blsi],
+        default=bmi_rorx)
+    # flag images: andn/bzhi/bls* touch SF/ZF/CF/OF, bextr ZF/CF/OF
+    # (SF untouched), shifts/pdep/pext/rorx none — oracle set_flags kwargs
+    bmi_sf = _msb(bmi_res, opsize) != 0
+    bmi_zf = bmi_res == _u(0)
+    bmi_cf = jnp.select(
+        [sub == U.BMI_BZHI, sub == U.BMI_BLSR, sub == U.BMI_BLSMSK,
+         sub == U.BMI_BLSI],
+        [bmi_n8 > (bits_u - _u(1)), bmi_src == _u(0), bmi_src == _u(0),
+         bmi_src != _u(0)],
+        default=jnp.bool_(False))
+    bmi_szco = _u(_SF | _ZF | _CF | _OF)
+    bmi_flag_bits = _mkflags(bmi_cf, jnp.bool_(False), jnp.bool_(False),
+                             bmi_zf, bmi_sf, jnp.bool_(False))
+    bmi_rf = jnp.select(
+        [(sub == U.BMI_ANDN) | (sub == U.BMI_BZHI) | (sub == U.BMI_BLSR)
+         | (sub == U.BMI_BLSMSK) | (sub == U.BMI_BLSI),
+         sub == U.BMI_BEXTR],
+        [(rf & ~bmi_szco) | (bmi_flag_bits & bmi_szco),
+         (rf & ~_u(_ZF | _CF | _OF)) | (bmi_flag_bits & _u(_ZF | _CF | _OF))],
+        default=rf)
+
+    # MSR (rdmsr/wrmsr) over the MSR-backed machine fields (msr_known
+    # computed with the unsupported gate above; same MSR_ATTR source)
+    msr_rval = jnp.select(
+        [msr_id == _u(mid) for mid in MSR_ATTR],
+        [st.tsc + st.icount if attr == "tsc" else getattr(st, attr)
+         for attr in MSR_ATTR.values()],
+        default=_u(0))
+    msr_wval = ((gpr[2] & _u(0xFFFFFFFF)) << _u(32)) | (gpr[0] & _u(0xFFFFFFFF))
 
     # CMPXCHG / XADD --------------------------------------------------
     cx_acc = rax_op
@@ -1547,10 +1631,12 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_ssealu, (sub == U.SSE_PMOVMSKB) | (sub == U.SSE_PEXTRW)),
         (is_ssefp, fp_is_f2i),
         (is_x87, sub == U.X87_FNSTSW_AX),
+        (is_(U.OPC_PEXT), jnp.bool_(True)),
+        (is_(U.OPC_MSR), sub == 0),   # rdmsr -> eax
     ], jnp.bool_(False))
     w1_idx = opc_list([
         (is_mul, jnp.where(is_mul2, dr, i0)),
-        (is_(U.OPC_DIV), i0),
+        (is_(U.OPC_DIV) | is_(U.OPC_MSR), i0),
         (is_(U.OPC_CONVERT), jnp.where(sub == 0, i0, i2_)),
         (is_(U.OPC_FLAGOP), jnp.int32(U.REG_AH_BASE)),
         (is_leave, i5_),
@@ -1589,13 +1675,15 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_ssealu, jnp.where(sub == U.SSE_PEXTRW, pextrw_val, pmov_mask)),
         (is_ssefp, f2i_val),
         (is_x87, fpsw_v & _u(0xFFFF)),
+        (is_(U.OPC_PEXT), bmi_res),
+        (is_(U.OPC_MSR), msr_rval & _u(0xFFFFFFFF)),
     ], _u(0))
     w1_size = opc_list([
         (is_mul, jnp.where(is_mul2, opsize,
                            jnp.where(opsize == 1, jnp.int32(2), opsize))),
         (is_(U.OPC_FLAGOP), jnp.int32(1)),
         (is_leave | is_(U.OPC_RDTSC) | is_(U.OPC_SYSCALL)
-         | is_(U.OPC_MOVCR), jnp.int32(8)),
+         | is_(U.OPC_MOVCR) | is_(U.OPC_MSR), jnp.int32(8)),
         (is_(U.OPC_XGETBV) | is_ssealu, jnp.int32(4)),
         (is_x87, jnp.int32(2)),  # fnstsw ax
     ], opsize)
@@ -1609,6 +1697,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_XADD), jnp.bool_(True)),
         (is_(U.OPC_RDTSC) | is_(U.OPC_XGETBV), jnp.bool_(True)),
         (is_(U.OPC_SYSCALL), syscall_entry),
+        (is_(U.OPC_MSR), sub == 0),   # rdmsr -> edx
     ], jnp.bool_(False))
     w2_idx = opc_list([
         (is_(U.OPC_XCHG) | is_(U.OPC_XADD), sr),
@@ -1624,10 +1713,12 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_RDTSC), tsc_now >> _u(32)),
         (is_(U.OPC_XGETBV), _u(0)),
         (is_(U.OPC_SYSCALL), next_rip),
+        (is_(U.OPC_MSR), msr_rval >> _u(32)),
     ], _u(0))
     w2_size = opc_list([
         (is_(U.OPC_DIV), jnp.where(opsize == 1, jnp.int32(1), opsize)),
-        (is_(U.OPC_RDTSC) | is_(U.OPC_SYSCALL), jnp.int32(8)),
+        (is_(U.OPC_RDTSC) | is_(U.OPC_SYSCALL) | is_(U.OPC_MSR),
+         jnp.int32(8)),
         (is_(U.OPC_XGETBV), jnp.int32(4)),
     ], opsize)
 
@@ -1732,6 +1823,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_ssealu & (sub == U.SSE_PTEST), ptest_rf),
         (is_ssefp & fp_is_comi, ucomi_rf),
         (is_x87 & (sub == U.X87_COMI), x87_comi_rf),
+        (is_(U.OPC_PEXT), bmi_rf),
     ], rf)
     new_rf = jnp.where(commit, rf_exec | _u(0x2), rf)
 
@@ -1755,6 +1847,24 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     sw = commit & is_swapgs
     new_gs = jnp.where(sw, st.kernel_gs_base, st.gs_base)
     new_kgs = jnp.where(sw, st.gs_base, st.kernel_gs_base)
+
+    # wrmsr state writes, driven by the same MSR_ATTR map (tsc keeps
+    # rdtsc = tsc_base + icount coherent, same adjustment as the oracle);
+    # gs bases chain after swapgs's values
+    msrw = commit & is_(U.OPC_MSR) & (sub == 1)
+    _msr_state = {"gs_base": new_gs, "kernel_gs_base": new_kgs}
+    for _mid, _attr in MSR_ATTR.items():
+        base = _msr_state.get(_attr, getattr(st, _attr))
+        val = msr_wval - st.icount if _attr == "tsc" else msr_wval
+        _msr_state[_attr] = jnp.where(msrw & (msr_id == _u(_mid)), val, base)
+    new_lstar = _msr_state["lstar"]
+    new_star = _msr_state["star"]
+    new_sfmask = _msr_state["sfmask"]
+    new_efer = _msr_state["efer"]
+    new_tsc = _msr_state["tsc"]
+    new_fs = _msr_state["fs_base"]
+    new_gs = _msr_state["gs_base"]
+    new_kgs = _msr_state["kernel_gs_base"]
 
     # -- CS/SS selectors (CPL tracking for host exception delivery) -------
     # SYSCALL loads CPL-0 selectors from IA32_STAR[47:32]; SYSRET the CPL-3
@@ -1866,7 +1976,9 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         gpr=new_gpr, rip=new_rip, rflags=new_rf, xmm=new_xmm,
         fpst=new_fpst, fpcw=new_fpcw, fpsw=new_fpsw, fptw=new_fptw,
         mxcsr=new_mxcsr,
-        gs_base=new_gs, kernel_gs_base=new_kgs,
+        fs_base=new_fs, gs_base=new_gs, kernel_gs_base=new_kgs,
+        lstar=new_lstar, star=new_star, sfmask=new_sfmask,
+        efer=new_efer, tsc=new_tsc,
         cr0=new_cr0, cr3=new_cr3, cr4=new_cr4, cr8=new_cr8,
         cs=new_cs, ss=new_ss,
         status=new_status, icount=new_icount, rdrand=new_rdrand,
