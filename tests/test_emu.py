@@ -704,8 +704,7 @@ def test_jecxz_a32():
 
 
 def test_enter_leave_roundtrip():
-    """enter size,0 (oracle-serviced) pairs with leave; nested-level forms
-    stay INVALID."""
+    """enter size,0 pairs with leave; nested-level forms stay INVALID."""
     from tests.asmhelper import assemble as _asm
     from wtf_tpu.cpu.uops import OPC_INVALID, OPC_LEAVE
 

@@ -211,6 +211,16 @@ DIFF_CASES = [
         skip2:
         skip1:
         hlt""", None),
+    ("enter_leave_roundtrip", """
+        mov rbp, 0x1122334455667788
+        mov rdi, rsp
+        enter 0x40, 0
+        mov rax, rbp
+        mov rbx, [rbp]
+        lea rcx, [rbp-0x40]
+        leave
+        mov rdx, rsp
+        hlt""", None),
     ("msr_roundtrip", """
         mov ecx, 0xC0000082
         mov eax, 0x11223344

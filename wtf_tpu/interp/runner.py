@@ -759,7 +759,6 @@ class Runner:
 
     def _is_oracle_uop(self, uop) -> bool:
         return (uop.opc in self._ORACLE_OPCS
-                or (uop.opc == U.OPC_LEAVE and uop.sub == 1)  # enter
                 or (uop.opc == U.OPC_X87
                     and uop.sub in self._X87_ORACLE_SUBS))
 

@@ -390,7 +390,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     is_push, is_pop = is_(U.OPC_PUSH), is_(U.OPC_POP)
     is_pushf, is_popf = is_(U.OPC_PUSHF), is_(U.OPC_POPF)
     is_call, is_ret = is_(U.OPC_CALL), is_(U.OPC_RET)
-    is_leave = is_(U.OPC_LEAVE)
+    is_leave = is_(U.OPC_LEAVE) & (sub == 0)
+    is_enter = is_(U.OPC_LEAVE) & (sub == 1)
     is_sse = is_(U.OPC_SSEMOV) | is_(U.OPC_SSEALU)
     is_ssefp = is_(U.OPC_SSEFP)
     is_x87 = is_(U.OPC_X87)
@@ -443,7 +444,6 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         | is_(U.OPC_SSECVT) | is_(U.OPC_PCLMUL)
         | is_(U.OPC_STACKSTR)
         | x87_oracle
-        | (is_(U.OPC_LEAVE) & (sub == 1))  # enter: oracle-serviced
         # pinsrw m16: a 2-byte load outside the 16-byte operand window
         | (is_(U.OPC_SSEALU) & (sub == U.SSE_PINSRW) & (sk == U.K_MEM))
         | (is_(U.OPC_RDGSBASE) & (sub != 4))
@@ -503,6 +503,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     push_size = jnp.where(is_pushf | is_call, jnp.int32(8), opsize)
     st_addr = opc_list([
         (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
+        (is_enter, rsp - _u(8)),
         (s_movs | s_stos, rdi),
     ], ea)
     # stores and pushes span the same byte count; x87 stores their
@@ -1619,7 +1620,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_BSWAP), jnp.bool_(True)),
         (is_(U.OPC_CMPXCHG), dk == U.K_REG),
         (is_(U.OPC_XADD), dk == U.K_REG),
-        (is_leave, jnp.bool_(True)),
+        (is_leave | is_enter, jnp.bool_(True)),
         (is_(U.OPC_RDTSC), jnp.bool_(True)),
         (is_(U.OPC_RDRAND), jnp.bool_(True)),
         (is_(U.OPC_XGETBV), jnp.bool_(True)),
@@ -1639,7 +1640,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_DIV) | is_(U.OPC_MSR), i0),
         (is_(U.OPC_CONVERT), jnp.where(sub == 0, i0, i2_)),
         (is_(U.OPC_FLAGOP), jnp.int32(U.REG_AH_BASE)),
-        (is_leave, i5_),
+        (is_leave | is_enter, i5_),
         (is_(U.OPC_RDTSC) | is_(U.OPC_XGETBV), i0),
         (is_string, i0),
         (is_(U.OPC_SYSCALL), i11_),
@@ -1664,6 +1665,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_CMPXCHG), cx_store),
         (is_(U.OPC_XADD), xadd_r),
         (is_leave, l1_lo),
+        (is_enter, rsp - _u(8)),   # rbp = frame pointer
         (is_(U.OPC_RDTSC), tsc_now & _u(0xFFFFFFFF)),
         (is_(U.OPC_RDRAND), rdrand_next & opmask),
         (is_(U.OPC_XGETBV), _u(7)),
@@ -1682,7 +1684,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_mul, jnp.where(is_mul2, opsize,
                            jnp.where(opsize == 1, jnp.int32(2), opsize))),
         (is_(U.OPC_FLAGOP), jnp.int32(1)),
-        (is_leave | is_(U.OPC_RDTSC) | is_(U.OPC_SYSCALL)
+        (is_leave | is_enter | is_(U.OPC_RDTSC) | is_(U.OPC_SYSCALL)
          | is_(U.OPC_MOVCR) | is_(U.OPC_MSR), jnp.int32(8)),
         (is_(U.OPC_XGETBV) | is_ssealu, jnp.int32(4)),
         (is_x87, jnp.int32(2)),  # fnstsw ax
@@ -1723,13 +1725,15 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     ], opsize)
 
     # rsp adjustment (push_size computed with the store span, section 4b)
-    w3_cond = is_push | is_pushf | is_call | is_pop | is_popf | is_ret | is_leave
+    w3_cond = (is_push | is_pushf | is_call | is_pop | is_popf | is_ret
+               | is_leave | is_enter)
     w3_val = opc_list([
         (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
         (is_pop, rsp + opsize.astype(jnp.uint64)),
         (is_popf, rsp + _u(8)),
         (is_ret, rsp + _u(8) + imm),
         (is_leave, rbp + _u(8)),
+        (is_enter, rsp - _u(8) - imm),  # push rbp then alloc imm bytes
     ], rsp)
 
     # string pointer/count updates
@@ -1749,7 +1753,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     ], jnp.bool_(False))
     st_need = live & ~unsupported & ~rep_skip & (
         ((dk == U.K_MEM) & mem_class_writes)
-        | is_push | is_pushf | is_call | s_movs | s_stos | x87_store)
+        | is_push | is_pushf | is_call | is_enter
+        | s_movs | s_stos | x87_store)
     st_lo = opc_list([
         (is_(U.OPC_MOV) | is_push, src_val),
         (is_(U.OPC_ALU), alu_r),
@@ -1763,6 +1768,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_XCHG), src_val),
         (is_call, next_rip),
         (is_pushf, rf | _u(0x2)),
+        (is_enter, rbp),
         (s_stos, rax_op),
         (s_movs, l1_lo),
         # movhps-store (sub 5) writes the HIGH xmm limb; everything else
