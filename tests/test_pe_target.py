@@ -92,6 +92,30 @@ def test_real_dll_batch_campaign():
     assert results[1].name == "crash-read-0x24002000"
 
 
+def test_pe_custom_mutator_campaign_finds_the_oob():
+    """The structure-aware mutator's count lies walk real MSVC code off
+    the points buffer within a few batches (the custom-mutator posture
+    the reference demos on tlv_server, exercised on a real DLL)."""
+    import random
+
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+
+    rng = random.Random(7)
+    be = make_backend("tpu", n_lanes=8)
+    corpus = Corpus(rng=rng)
+    corpus.add(BENIGN)
+    mutator = demo_pe.TARGET.create_mutator(rng, 0x400)
+    loop = FuzzLoop(be, demo_pe.TARGET, mutator, corpus)
+    for _ in range(8):
+        loop.run_one_batch()
+        if loop.stats.crashes:
+            break
+    assert loop.stats.crashes > 0
+    assert any(n.startswith("crash-read-") for n in loop.crash_names), (
+        loop.crash_names)
+
+
 def test_pe_loader_exports_and_image():
     from wtf_tpu.utils.pe import load_pe
 

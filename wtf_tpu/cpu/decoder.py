@@ -739,7 +739,7 @@ def _decode_primary(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         return
 
     if op == 0xC8:  # enter imm16, imm8 — level 0 only (nested frames are
-        # a pre-386 idiom no 64-bit compiler emits); sub 1, oracle-serviced
+        # a pre-386 idiom no 64-bit compiler emits); OPC_LEAVE sub 1
         size = cur.u16()
         level = cur.u8()
         if level != 0:

@@ -35,6 +35,7 @@ from pathlib import Path
 from typing import Optional
 
 from wtf_tpu.core.results import Ok
+from wtf_tpu.fuzz.mutator import Mutator
 from wtf_tpu.harness.targets import Target
 from wtf_tpu.snapshot.loader import Snapshot
 from wtf_tpu.snapshot.synthetic import SyntheticSnapshotBuilder
@@ -173,10 +174,74 @@ def _insert_testcase(backend, data: bytes) -> bool:
     return True
 
 
+class PeStructureMutator(Mutator):
+    """Structure-aware mutator for the demo_pe testcase format
+    {npoints:u32, radius:f64, points:f64[3][]} — the custom-mutator role
+    the reference demonstrates on its tlv_server (CustomMutator_t,
+    fuzzer_tlv_server.cc:204-365), here driving REAL MSVC code:
+    count lies (the OOB trigger), adversarial FP values for the radius
+    and coordinates (NaN payloads, infinities, denormals — the device
+    FP path's divert stress), and element-level add/dup/delete."""
+
+    # adversarial f64 bit patterns (denormals exercise the oracle divert)
+    _SPECIALS = (0x0000000000000001, 0x000FFFFFFFFFFFFF,  # denormals
+                 0x7FF0000000000000, 0xFFF0000000000000,  # +/-inf
+                 0x7FF8000000001234, 0x7FF0000000000BAD,  # qnan/snan
+                 0x8000000000000000, 0x3FF0000000000000,  # -0, 1.0
+                 0x7FEFFFFFFFFFFFFF, 0x0010000000000000)  # max, min-normal
+
+    def __init__(self, rng, max_len: int = 0x400):
+        self.rng = rng
+        self.max_len = max_len
+
+    def get_new_testcase(self, corpus) -> bytes:
+        rng = self.rng
+        base = corpus.pick() if corpus is not None else None
+        if not base or len(base) < 12:
+            base = struct.pack("<Id", 2, 1.0) + struct.pack(
+                "<6d", *(rng.uniform(-8, 8) for _ in range(6)))
+        (npoints,) = struct.unpack_from("<I", base, 0)
+        (radius,) = struct.unpack_from("<Q", base, 4)
+        pts = bytearray(base[12:12 + POINTS_CAP])
+        n_elem = len(pts) // 24
+        for _ in range(rng.randrange(1, 4)):
+            op = rng.randrange(6)
+            if op == 0:    # count lies: boundary / overclaim / huge
+                npoints = rng.choice(
+                    (0, 1, n_elem, n_elem + 1, n_elem + rng.randrange(64),
+                     0x7FFFFFFF, rng.getrandbits(32)))
+            elif op == 1:  # adversarial radius
+                radius = rng.choice(self._SPECIALS) ^ rng.getrandbits(2)
+            elif op == 2 and n_elem:  # poison one coordinate
+                off = rng.randrange(n_elem * 3) * 8
+                struct.pack_into(
+                    "<Q", pts, off,
+                    rng.choice(self._SPECIALS) ^ rng.getrandbits(2))
+            elif op == 3 and len(pts) + 24 <= POINTS_CAP:  # append element
+                pts += struct.pack(
+                    "<3d", *(rng.uniform(-100, 100) for _ in range(3)))
+                n_elem += 1
+            elif op == 4 and n_elem > 1:  # delete element
+                k = rng.randrange(n_elem) * 24
+                del pts[k:k + 24]
+                n_elem -= 1
+            else:          # raw byte flip inside the coordinates
+                if pts:
+                    pts[rng.randrange(len(pts))] ^= 1 << rng.randrange(8)
+        out = struct.pack("<I", npoints & 0xFFFFFFFF) + struct.pack(
+            "<Q", radius) + bytes(pts)
+        return out[:self.max_len]
+
+
+def _create_mutator(rng, max_len: int):
+    return PeStructureMutator(rng, max_len)
+
+
 TARGET = Target(
     name="demo_pe",
     init=_init,
     insert_testcase=_insert_testcase,
+    create_mutator=_create_mutator,
     snapshot=build_snapshot,
 )
 
