@@ -211,6 +211,17 @@ DIFF_CASES = [
         skip2:
         skip1:
         hlt""", None),
+    ("fsgsbase_ops", """
+        mov rax, 0x5678DEADBEEF
+        wrfsbase rax
+        rdfsbase rbx
+        wrgsbase rax
+        rdgsbase rcx
+        mov esi, 0xCAFE0000
+        wrfsbase esi
+        rdfsbase rdx
+        rdfsbase r8d
+        hlt""", None),
     ("enter_leave_roundtrip", """
         mov rbp, 0x1122334455667788
         mov rdi, rsp
@@ -421,6 +432,21 @@ DIFF_CASES = [
                          [(c[0], c[1], c[2]) for c in DIFF_CASES])
 def test_device_vs_oracle_mem_cases(name, snippet, data):
     assert_matches_oracle(snippet, data=data)
+
+
+def test_wrfsbase_noncanonical_faults():
+    """Hardware #GPs on a non-canonical wr{fs,gs}base source; both
+    engines surface it through the non-canonical fault seam (review
+    fix) instead of silently loading the base."""
+    runner = make_runner(
+        "mov rax, 0x8000000000000000\nwrfsbase rax\nhlt", n_lanes=2)
+    status = runner.run()
+    for lane in range(2):
+        assert StatusCode(int(status[lane])) == StatusCode.PAGE_FAULT
+        assert int(np.asarray(runner.machine.fault_gva)[lane]) \
+            == 0x8000000000000000
+    # the base must NOT have been loaded
+    assert int(np.asarray(runner.machine.fs_base)[0]) == 0
 
 
 def test_syscall_transition():

@@ -1010,10 +1010,16 @@ def _decode_0f(cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
 
     if op == 0xAE:
         # group 15: fences; ldmxcsr/stmxcsr and fxsave/fxrstor are real
-        # state movers (oracle-serviced via OPC_X87)
+        # state movers (oracle-serviced via OPC_X87); F3-prefixed
+        # register forms are rd/wrfsbase+rd/wrgsbase
         modrm = _ModRM(cur, pfx)
         sub = modrm.reg & 7
-        if not modrm.is_mem and sub in (5, 6, 7):  # lfence/mfence/sfence
+        if pfx.rep and not modrm.is_mem and sub in (0, 1, 2, 3):
+            # rdfsbase/rdgsbase/wrfsbase/wrgsbase r32/r64
+            uop.opc, uop.sub = OPC_RDGSBASE, sub
+            uop.opsize = 8 if pfx.rex_w else 4
+            uop.dst_kind, uop.dst_reg = K_REG, modrm.rm_reg
+        elif not modrm.is_mem and sub in (5, 6, 7):  # l/m/sfence
             uop.opc = OPC_FENCE
         elif modrm.is_mem and sub in (0, 1, 2, 3, 4, 5):
             uop.opc = OPC_X87

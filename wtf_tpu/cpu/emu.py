@@ -869,6 +869,21 @@ class EmuCpu:
             if uop.sub == 4:  # swapgs
                 self.gs_base, self.kernel_gs_base = \
                     self.kernel_gs_base, self.gs_base
+            elif uop.sub == 0:  # rdfsbase
+                self.write_reg(uop.dst_reg, uop.opsize, self.fs_base)
+            elif uop.sub == 1:  # rdgsbase
+                self.write_reg(uop.dst_reg, uop.opsize, self.gs_base)
+            elif uop.sub in (2, 3):  # wrfsbase/wrgsbase (r32 zero-extends)
+                value = self.read_reg(uop.dst_reg, uop.opsize)
+                if (value >> 47) not in (0, 0x1FFFF):
+                    # hardware #GPs on a non-canonical base; MemFault on
+                    # the value routes through deliver_page_fault's
+                    # non-canonical -> #GP(0) path (cpu/interrupts.py)
+                    raise MemFault(value, write=False)
+                if uop.sub == 2:
+                    self.fs_base = value
+                else:
+                    self.gs_base = value
             else:
                 raise UnsupportedInsn(self.rip, uop.raw)
         elif opc == U.OPC_MOVCR:

@@ -110,6 +110,12 @@ def _sext(val, nbytes):
     return widened.astype(jnp.uint64)
 
 
+def _canon(gva):
+    """Canonical 48-bit address predicate (bits 63:47 all equal)."""
+    top = gva >> _u(47)
+    return (top == _u(0)) | (top == _u(0x1FFFF))
+
+
 def _parity_even(r):
     v = r & _u(0xFF)
     v = v ^ (v >> _u(4))
@@ -446,7 +452,10 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         | x87_oracle
         # pinsrw m16: a 2-byte load outside the 16-byte operand window
         | (is_(U.OPC_SSEALU) & (sub == U.SSE_PINSRW) & (sk == U.K_MEM))
-        | (is_(U.OPC_RDGSBASE) & (sub != 4))
+        # non-canonical wr{fs,gs}base #GPs on hardware: divert so the
+        # oracle raises it through the non-canonical -> #GP seam
+        | (is_(U.OPC_RDGSBASE) & ((sub == 2) | (sub == 3))
+           & ~_canon(_read_reg(gpr, dr, opsize)))
         # 67h string forms use 32-bit rsi/rdi/rcx; neither engine models
         # that — surface loudly instead of executing with 64-bit regs
         | (is_string & (f[F_A32] != 0))
@@ -1634,6 +1643,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_x87, sub == U.X87_FNSTSW_AX),
         (is_(U.OPC_PEXT), jnp.bool_(True)),
         (is_(U.OPC_MSR), sub == 0),   # rdmsr -> eax
+        (is_(U.OPC_RDGSBASE), (sub == 0) | (sub == 1)),  # rd{fs,gs}base
     ], jnp.bool_(False))
     w1_idx = opc_list([
         (is_mul, jnp.where(is_mul2, dr, i0)),
@@ -1679,6 +1689,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_x87, fpsw_v & _u(0xFFFF)),
         (is_(U.OPC_PEXT), bmi_res),
         (is_(U.OPC_MSR), msr_rval & _u(0xFFFFFFFF)),
+        (is_(U.OPC_RDGSBASE),
+         jnp.where(sub == 0, st.fs_base, st.gs_base)),
     ], _u(0))
     w1_size = opc_list([
         (is_mul, jnp.where(is_mul2, opsize,
@@ -1854,11 +1866,18 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     new_gs = jnp.where(sw, st.kernel_gs_base, st.gs_base)
     new_kgs = jnp.where(sw, st.gs_base, st.kernel_gs_base)
 
+    # wrfsbase/wrgsbase (r32 forms zero-extend via the masked reg read)
+    fsgs_val = _read_reg(gpr, dr, opsize)
+    fsgsw = commit & is_(U.OPC_RDGSBASE)
+    new_gs = jnp.where(fsgsw & (sub == 3), fsgs_val, new_gs)
+    fs_pre = jnp.where(fsgsw & (sub == 2), fsgs_val, st.fs_base)
+
     # wrmsr state writes, driven by the same MSR_ATTR map (tsc keeps
     # rdtsc = tsc_base + icount coherent, same adjustment as the oracle);
-    # gs bases chain after swapgs's values
+    # gs/fs bases chain after the swapgs/wrfsbase values
     msrw = commit & is_(U.OPC_MSR) & (sub == 1)
-    _msr_state = {"gs_base": new_gs, "kernel_gs_base": new_kgs}
+    _msr_state = {"gs_base": new_gs, "kernel_gs_base": new_kgs,
+                  "fs_base": fs_pre}
     for _mid, _attr in MSR_ATTR.items():
         base = _msr_state.get(_attr, getattr(st, _attr))
         val = msr_wval - st.icount if _attr == "tsc" else msr_wval
