@@ -262,18 +262,24 @@ def _pebench(platform: str) -> dict:
     c0 = loop.stats.testcases
     i0 = backend.stats["instructions"]
     f0 = backend.runner.stats["fallbacks"]
+    fc0 = dict(backend.runner.stats["fallbacks_by_opclass"])
     x0 = loop.stats.crashes
     start = time.time()
     while time.time() - start < seconds:
         loop.run_one_batch()
     elapsed = time.time() - start
     execs = loop.stats.testcases - c0
+    fc1 = backend.runner.stats["fallbacks_by_opclass"]
+    by_class = {k: v - fc0.get(k, 0) for k, v in fc1.items()
+                if v - fc0.get(k, 0) > 0}
     return {
         "workload": "gle64.vc14.dll glePolyCylinder mangle campaign",
         "execs_per_s": round(execs / elapsed, 2),
         "instr_per_s": round(
             (backend.stats["instructions"] - i0) / elapsed, 1),
         "oracle_fallbacks": backend.runner.stats["fallbacks"] - f0,
+        "fallbacks_by_opclass": dict(sorted(
+            by_class.items(), key=lambda kv: -kv[1])),
         "crashes": loop.stats.crashes - x0,
         "lanes": n_lanes,
         "degraded": platform == "cpu",
@@ -416,6 +422,71 @@ def _microbench(snapshot) -> dict:
     return out
 
 
+# r5 step-cost microbench numbers on the 1-core CPU stand-in (PERF.md
+# round-5 status) — the default `--micro-compare` baseline, so a step.py
+# change can be gated on "no worse than the last recorded round" without
+# hunting for a BENCH_rXX.json.
+MICRO_BASELINE_R5 = {
+    "chunk512_wall_s": 4.32,
+    "branchy_instr_per_s": 121_500.0,
+    "source": "PERF.md r5 CPU stand-in",
+}
+
+
+def micro_compare(baseline_path: str | None) -> None:
+    """`bench.py --micro-compare [baseline.json]`: run ONLY the step-cost
+    microbench (chunk512_wall_s, branchy_instr_per_s) and print one JSON
+    line with the current numbers, the baseline, and the delta ratios —
+    the before/after harness for step.py optimizations (u32 limb packing
+    etc.).  A prior run's output (or a BENCH_rXX.json with a "microbench"
+    extra) can be passed as the baseline; default is the r5 record.
+
+    Runs on the CPU platform unless BENCH_PLATFORM=native — the recorded
+    baselines are CPU stand-in numbers and cross-platform ratios would be
+    meaningless."""
+    if os.environ.get("BENCH_PLATFORM", "cpu") != "native":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    baseline = dict(MICRO_BASELINE_R5)
+    if baseline_path:
+        with open(baseline_path) as fh:
+            loaded = json.load(fh)
+        for key in ("microbench", "current"):  # full bench / prior compare
+            if key in loaded:
+                loaded = loaded[key]
+                break
+        baseline = {"source": baseline_path, **{
+            k: loaded[k] for k in ("chunk512_wall_s", "branchy_instr_per_s")
+            if k in loaded}}
+
+    from wtf_tpu.harness import demo_tlv
+
+    current = _microbench(demo_tlv.build_snapshot())
+    delta = {}
+    if "chunk512_wall_s" in baseline:
+        delta["chunk512_wall_s_ratio"] = round(
+            current["chunk512_wall_s"] / baseline["chunk512_wall_s"], 4)
+    if "branchy_instr_per_s" in baseline:
+        delta["branchy_instr_per_s_ratio"] = round(
+            current["branchy_instr_per_s"] / baseline["branchy_instr_per_s"],
+            4)
+    # regression := step got slower AND throughput dropped beyond noise
+    regression = (delta.get("chunk512_wall_s_ratio", 1.0) > 1.10
+                  and delta.get("branchy_instr_per_s_ratio", 1.0) < 0.90)
+    print(json.dumps({
+        "metric": "step-cost micro-compare",
+        "current": current,
+        "baseline": baseline,
+        "delta": delta,
+        "regression": regression,
+    }))
+
+
 def main() -> None:
     # total budget divided across attempts so a hanging TPU init can never
     # push the final (cpu) attempt past the driver's outer timeout.  A
@@ -469,5 +540,8 @@ def main() -> None:
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         worker()
+    elif "--micro-compare" in sys.argv:
+        _args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        micro_compare(_args[0] if _args else None)
     else:
         main()

@@ -133,3 +133,66 @@ def test_tlv_structure_mutator_shapes():
         tc = m.get_new_testcase(corpus)
         assert len(tc) <= 256
     assert m.get_new_testcase(None)  # empty-corpus generation works
+
+
+def test_pe_heap_stubs_bounded_to_arena():
+    """The demo_pe malloc/realloc bump stubs return NULL once an allocation
+    would pass the 16-page HEAP arena (or wrap the bump pointer), instead of
+    handing out pointers past the mapping — unbounded bumps made
+    allocation-heavy mangled inputs crash on harness arena overruns that
+    were then misattributed to the target DLL (ADVICE r5).  Runs the
+    SHIPPED stub bytes on both engines (no DLL needed)."""
+    from tests.test_step import assert_matches_oracle
+    from wtf_tpu.harness.demo_pe import (
+        HEAP_BASE, HEAP_PAGES, HEAP_STATE, _STUBS,
+    )
+
+    stub_gva = 0x2100_0000
+    heap_end = HEAP_BASE + HEAP_PAGES * 0x1000
+    data = {
+        stub_gva: _STUBS["malloc"].ljust(0x40, b"\xcc") + _STUBS["realloc"],
+        HEAP_STATE: HEAP_BASE.to_bytes(8, "little"),
+        HEAP_BASE: b"\x00" * (HEAP_PAGES * 0x1000),
+    }
+    asm = f"""
+        mov rcx, 0x20
+        mov rax, {stub_gva}
+        call rax
+        mov r12, rax          # in-arena alloc -> HEAP_BASE
+        mov byte ptr [rax], 0x5A
+        mov rcx, {HEAP_PAGES * 0x1000}
+        mov rax, {stub_gva}
+        call rax
+        mov r13, rax          # would pass HEAP_END -> NULL
+        mov rcx, -32
+        mov rax, {stub_gva}
+        call rax
+        mov r14, rax          # bump-pointer wrap -> NULL
+        mov rcx, -1
+        mov rax, {stub_gva}
+        call rax
+        mov rbp, rax          # SIZE_MAX: must not wrap through +15 align
+        mov rcx, r12
+        mov rdx, 0x40
+        mov rax, {stub_gva + 0x40}
+        call rax
+        mov rbx, rax          # in-arena realloc -> next block, data copied
+        movzx rbx, byte ptr [rbx]
+        mov rcx, r12
+        mov rdx, 0x100000
+        mov rax, {stub_gva + 0x40}
+        call rax
+        mov r9, rax           # oversized realloc -> NULL
+        mov r10, {HEAP_STATE}
+        mov r15, [r10]        # final bump pointer
+        hlt
+    """
+    _, emu = assert_matches_oracle(asm, data=data)
+    assert emu.gpr[12] == HEAP_BASE                   # r12
+    assert emu.gpr[13] == 0                           # r13: bounded
+    assert emu.gpr[14] == 0                           # r14: wrap caught
+    assert emu.gpr[5] == 0                            # rbp: SIZE_MAX -> NULL
+    assert emu.gpr[3] == 0x5A                         # rbx: realloc copied
+    assert emu.gpr[9] == 0                            # r9: bounded realloc
+    assert emu.gpr[15] == HEAP_BASE + 0x20 + 0x40     # r15: two live blocks
+    assert emu.gpr[15] < heap_end

@@ -1,0 +1,407 @@
+"""Unit tests for the u32 limb-arithmetic library (interp/limbs.py) and the
+HLO guard for the ported step paths.
+
+Two jobs:
+
+1. Property-style corner grids: every limb helper checked against Python
+   big-int ground truth at the places limb code breaks — carry-out chains,
+   cross-limb shifts by 0/31/32/33/63(/64), widening multiply highs, flag
+   bits at every operand width.
+
+2. The no-u64 guard (ISSUE 2 acceptance): compile the ported functions —
+   the limb library itself, the step's ALU/unary/addressing cores, the
+   decode-cache hash probe — and assert the optimized HLO contains ZERO
+   64-bit integer ops.  This is what keeps a future edit from silently
+   reintroducing u64 (XLA would lower it to a u32 pair on TPU and Pallas
+   would reject it outright) on the paths this PR ported.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from wtf_tpu.interp import limbs as L
+from wtf_tpu.interp import step as S
+from wtf_tpu.interp.uoptable import UopTable
+from wtf_tpu.utils.hashing import mix64, splitmix64
+
+MASK64 = (1 << 64) - 1
+
+# corner values: limb boundaries, sign boundaries, all-ones, and a few
+# irregular bit patterns
+CORNERS = [
+    0, 1, 2, 0xFF, 0x100, 0x7FFF, 0x8000, 0xFFFF, 0x10000,
+    0x7FFFFFFF, 0x80000000, 0xFFFFFFFF, 0x100000000, 0x123456789,
+    0x7FFFFFFFFFFFFFFF, 0x8000000000000000, 0xFFFFFFFFFFFFFFFF,
+    0x1122334455667788, 0xFEDCBA9876543210, 0x00000001FFFFFFFF,
+    0xFFFFFFFF00000000, 0x0F0F0F0F0F0F0F0F,
+]
+SHIFTS = [0, 1, 7, 31, 32, 33, 63, 64, 65, 127]
+
+
+def _pairs(values):
+    v = np.array(values, dtype=np.uint64)
+    u = L.unpack_np(v)
+    return jnp.asarray(u[:, 0]), jnp.asarray(u[:, 1])
+
+
+def _ints(pair):
+    lo = np.asarray(pair[0], dtype=np.uint64)
+    hi = np.asarray(pair[1], dtype=np.uint64)
+    return [int(l) | (int(h) << 32) for l, h in zip(lo.ravel(), hi.ravel())]
+
+
+def _cross(xs, ys):
+    """All (x, y) combinations as two flat lists."""
+    ax = [x for x in xs for _ in ys]
+    ay = [y for _ in xs for y in ys]
+    return ax, ay
+
+
+def test_pack_unpack_roundtrip_np():
+    v = np.array(CORNERS, dtype=np.uint64)
+    assert (L.pack_np(L.unpack_np(v)) == v).all()
+    m = np.arange(32, dtype=np.uint64).reshape(2, 4, 4)
+    assert (L.pack_np(L.unpack_np(m)) == m).all()
+
+
+def test_pack_unpack_roundtrip_device():
+    v = jnp.asarray(np.array(CORNERS, dtype=np.uint64))
+    assert (L.pack_u64(L.unpack_u64(v)) == v).all()
+    p = L.pair(v)
+    assert (L.to_u64(p) == v).all()
+
+
+def test_add_sub_carry_chains():
+    ax, bx = _cross(CORNERS, CORNERS)
+    a, b = _pairs(ax), _pairs(bx)
+    for carry in (False, True):
+        cin = jnp.full(len(ax), carry)
+        s, cout = L.adc64(a, b, cin)
+        d, bout = L.sbb64(a, b, cin)
+        for i, (x, y) in enumerate(zip(ax, bx)):
+            add = x + y + carry
+            assert _ints(s)[i] == add & MASK64, f"adc {x:#x}+{y:#x}+{carry}"
+            assert bool(np.asarray(cout)[i]) == (add > MASK64)
+            sub = x - y - carry
+            assert _ints(d)[i] == sub & MASK64, f"sbb {x:#x}-{y:#x}-{carry}"
+            assert bool(np.asarray(bout)[i]) == (sub < 0)
+
+
+def test_logic_neg_compare():
+    ax, bx = _cross(CORNERS, CORNERS)
+    a, b = _pairs(ax), _pairs(bx)
+    assert _ints(L.and64(a, b)) == [x & y for x, y in zip(ax, bx)]
+    assert _ints(L.or64(a, b)) == [x | y for x, y in zip(ax, bx)]
+    assert _ints(L.xor64(a, b)) == [x ^ y for x, y in zip(ax, bx)]
+    assert _ints(L.not64(a)) == [x ^ MASK64 for x in ax]
+    assert _ints(L.neg64(a)) == [(-x) & MASK64 for x in ax]
+    assert list(np.asarray(L.eq64(a, b))) == [x == y for x, y in zip(ax, bx)]
+    assert list(np.asarray(L.ltu64(a, b))) == [x < y for x, y in zip(ax, bx)]
+    assert list(np.asarray(L.leu64(a, b))) == [x <= y for x, y in zip(ax, bx)]
+    assert list(np.asarray(L.is_zero64(a))) == [x == 0 for x in ax]
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("shl64", lambda x, s: (x << s) & MASK64 if s < 64 else 0),
+    ("shr64", lambda x, s: x >> s if s < 64 else 0),
+    ("sar64", lambda x, s: (x - ((x >> 63) << 64)) >> min(s, 63) & MASK64),
+])
+def test_shifts_across_limb_boundary(op, ref):
+    ax, sx = _cross(CORNERS, SHIFTS)
+    a = _pairs(ax)
+    s = jnp.asarray(np.array(sx, dtype=np.uint32))
+    got = _ints(getattr(L, op)(a, s))
+    for i, (x, sh) in enumerate(zip(ax, sx)):
+        assert got[i] == ref(x, sh) & MASK64, f"{op}({x:#x}, {sh})"
+
+
+def test_rotates():
+    ax, sx = _cross(CORNERS, SHIFTS)
+    a = _pairs(ax)
+    s = jnp.asarray(np.array(sx, dtype=np.uint32))
+    rol = _ints(L.rol64(a, s))
+    ror = _ints(L.ror64(a, s))
+    for i, (x, sh) in enumerate(zip(ax, sx)):
+        k = sh % 64
+        want_rol = ((x << k) | (x >> (64 - k))) & MASK64 if k else x
+        want_ror = ((x >> k) | (x << (64 - k))) & MASK64 if k else x
+        assert rol[i] == want_rol, f"rol64({x:#x}, {sh})"
+        assert ror[i] == want_ror, f"ror64({x:#x}, {sh})"
+
+
+def test_mul32_wide_highs():
+    vals = [0, 1, 2, 0xFF, 0xFFFF, 0x10000, 0x10001, 0x7FFFFFFF,
+            0x80000000, 0xFFFFFFFF, 0xDEADBEEF, 0x12345678]
+    ax, bx = _cross(vals, vals)
+    a = jnp.asarray(np.array(ax, dtype=np.uint32))
+    b = jnp.asarray(np.array(bx, dtype=np.uint32))
+    lo, hi = L.mul32_wide(a, b)
+    for i, (x, y) in enumerate(zip(ax, bx)):
+        p = x * y
+        assert int(np.asarray(lo)[i]) == p & 0xFFFFFFFF
+        assert int(np.asarray(hi)[i]) == p >> 32, f"mulhi {x:#x}*{y:#x}"
+
+
+def test_mul64_lo_and_splitmix():
+    ax, bx = _cross(CORNERS, CORNERS[:12])
+    a, b = _pairs(ax), _pairs(bx)
+    got = _ints(L.mul64_lo(a, b))
+    for i, (x, y) in enumerate(zip(ax, bx)):
+        assert got[i] == (x * y) & MASK64, f"mul64_lo {x:#x}*{y:#x}"
+    # splitmix64/mix64 must match the host reference bit-for-bit (the
+    # decode-cache probe and edge hash depend on it)
+    v = _pairs(CORNERS)
+    assert _ints(L.splitmix64(v)) == [splitmix64(x) for x in CORNERS]
+    assert _ints(L.mix64(v)) == [mix64(x) for x in CORNERS]
+
+
+@pytest.mark.parametrize("nbytes", [1, 2, 4, 8])
+def test_extend_mask_msb(nbytes):
+    a = _pairs(CORNERS)
+    n = jnp.full(len(CORNERS), nbytes, dtype=jnp.int32)
+    bits = min(nbytes, 8) * 8
+    m = (1 << bits) - 1
+    assert _ints(L.zext(a, n)) == [x & m for x in CORNERS]
+    want_sext = []
+    for x in CORNERS:
+        v = x & m
+        if v >> (bits - 1):
+            v |= MASK64 ^ m
+        want_sext.append(v)
+    assert _ints(L.sext(a, n)) == want_sext
+    assert list(np.asarray(L.msb(a, n))) == [
+        bool((x >> (bits - 1)) & 1) for x in CORNERS]
+
+
+def _ref_flags_add(a, b, bits, carry):
+    m = (1 << bits) - 1
+    am, bm = a & m, b & m
+    r = am + bm + carry
+    rm = r & m
+    return _mk_ref(cf=r > m, r=rm, bits=bits, af=(a ^ b ^ rm) & 0x10,
+                   of=((am ^ rm) & (bm ^ rm)) >> (bits - 1) & 1)
+
+
+def _ref_flags_sub(a, b, bits, borrow):
+    m = (1 << bits) - 1
+    am, bm = a & m, b & m
+    rm = (am - bm - borrow) & m
+    return _mk_ref(cf=am < bm + borrow, r=rm, bits=bits,
+                   af=(a ^ b ^ rm) & 0x10,
+                   of=((am ^ bm) & (am ^ rm)) >> (bits - 1) & 1)
+
+
+def _mk_ref(cf, r, bits, af, of):
+    pf = bin(r & 0xFF).count("1") % 2 == 0
+    return ((L.CF if cf else 0) | (L.PF if pf else 0) | (L.AF if af else 0)
+            | (L.ZF if r == 0 else 0)
+            | (L.SF if (r >> (bits - 1)) & 1 else 0)
+            | (L.OF if of else 0))
+
+
+@pytest.mark.parametrize("nbytes", [1, 2, 4, 8])
+def test_flag_bits_against_bigint(nbytes):
+    bits = nbytes * 8
+    m = (1 << bits) - 1
+    ops = [v & m for v in CORNERS]
+    ax, bx = _cross(ops, ops)
+    n = jnp.full(len(ax), nbytes, dtype=jnp.int32)
+    a, b = _pairs(ax), _pairs(bx)
+    for carry in (False, True):
+        cin = jnp.full(len(ax), carry)
+        r_add = L.zext(L.adc64(a, b, cin)[0], n)
+        fl_add = np.asarray(L.flags_add(a, b, r_add, n, cin))
+        r_sub = L.zext(L.sbb64(a, b, cin)[0], n)
+        fl_sub = np.asarray(L.flags_sub(a, b, r_sub, n, cin))
+        for i, (x, y) in enumerate(zip(ax, bx)):
+            assert int(fl_add[i]) == _ref_flags_add(x, y, bits, carry), (
+                f"flags_add({x:#x}, {y:#x}, c={carry}, n={nbytes})")
+            assert int(fl_sub[i]) == _ref_flags_sub(x, y, bits, carry), (
+                f"flags_sub({x:#x}, {y:#x}, b={carry}, n={nbytes})")
+    fl_logic = np.asarray(L.flags_logic(L.zext(L.and64(a, b), n), n))
+    for i, (x, y) in enumerate(zip(ax, bx)):
+        r = (x & y) & m
+        assert int(fl_logic[i]) == _mk_ref(cf=False, r=r, bits=bits,
+                                           af=0, of=0)
+
+
+def test_eval_cond_table():
+    # every flag combination over CF/PF/ZF/SF/OF x every condition code
+    combos = []
+    for mask in range(32):
+        rf = ((mask & 1) * L.CF | ((mask >> 1) & 1) * L.PF
+              | ((mask >> 2) & 1) * L.ZF | ((mask >> 3) & 1) * L.SF
+              | ((mask >> 4) & 1) * L.OF)
+        combos.append(rf)
+    for cc in range(18):
+        for rcx in (0, 1, 0xFFFFFFFF, 0x100000000, 0x1_0000_0001):
+            rf = jnp.asarray(np.array(combos, dtype=np.uint32))
+            rcx_l = _pairs([rcx] * len(combos))
+            got = np.asarray(L.eval_cond(rf, rcx_l, jnp.int32(cc)))
+            for i, flags in enumerate(combos):
+                cf, pf = bool(flags & L.CF), bool(flags & L.PF)
+                zf, sf = bool(flags & L.ZF), bool(flags & L.SF)
+                of = bool(flags & L.OF)
+                table = [of, not of, cf, not cf, zf, not zf,
+                         cf or zf, not (cf or zf), sf, not sf, pf, not pf,
+                         sf != of, sf == of, zf or (sf != of),
+                         not zf and (sf == of)]
+                if cc == 16:
+                    want = rcx == 0
+                elif cc == 17:
+                    want = rcx & 0xFFFFFFFF == 0
+                else:
+                    want = table[cc]
+                assert bool(got[i]) == want, f"cc={cc} flags={flags:#x}"
+
+
+# ---------------------------------------------------------------------------
+# the no-u64 guard for the ported step paths
+# ---------------------------------------------------------------------------
+
+def _assert_no_u64(fn, *args, name=""):
+    text = jax.jit(fn).lower(*args).compile().as_text()
+    assert "u64[" not in text and "s64[" not in text, (
+        f"64-bit integer ops reintroduced in ported path {name or fn}")
+
+
+def _u32s(*vals):
+    return tuple(jnp.uint32(v) for v in vals)
+
+
+def test_hlo_limb_library_is_u64_free():
+    p = _u32s(0x55667788, 0x11223344)
+    q = _u32s(0xDEADBEEF, 0x12345678)
+    cin = jnp.bool_(True)
+    n = jnp.int32(4)
+    s = jnp.uint32(33)
+    _assert_no_u64(lambda a, b, c: L.adc64(a, b, c), p, q, cin, name="adc64")
+    _assert_no_u64(lambda a, b, c: L.sbb64(a, b, c), p, q, cin, name="sbb64")
+    _assert_no_u64(lambda a, k: L.shl64(a, k), p, s, name="shl64")
+    _assert_no_u64(lambda a, k: L.shr64(a, k), p, s, name="shr64")
+    _assert_no_u64(lambda a, k: L.sar64(a, k), p, s, name="sar64")
+    _assert_no_u64(lambda a, k: L.rol64(a, k), p, s, name="rol64")
+    _assert_no_u64(lambda a, b: L.mul64_lo(a, b), p, q, name="mul64_lo")
+    _assert_no_u64(lambda a: L.splitmix64(a), p, name="splitmix64")
+    _assert_no_u64(lambda a, k: L.sext(a, k), p, n, name="sext")
+    _assert_no_u64(lambda a, b, r, k, c: L.flags_add(a, b, r, k, c),
+                   p, q, p, n, cin, name="flags_add")
+    _assert_no_u64(lambda a, b, r, k, c: L.flags_sub(a, b, r, k, c),
+                   p, q, p, n, cin, name="flags_sub")
+    _assert_no_u64(lambda rf, rcx, cc: L.eval_cond(rf, rcx, cc),
+                   jnp.uint32(0x246), p, jnp.int32(5), name="eval_cond")
+
+
+def test_hlo_step_alu_path_is_u64_free():
+    p = _u32s(0x55667788, 0x11223344)
+    q = _u32s(0xDEADBEEF, 0x12345678)
+    args = (jnp.int32(0), p, q, jnp.bool_(True), jnp.int32(8),
+            jnp.uint32(0x246))
+    _assert_no_u64(lambda sub, a, b, c, n, rf: S.alu_limb(sub, a, b, c, n, rf),
+                   *args, name="alu_limb")
+    _assert_no_u64(
+        lambda sub, a, c, n, rf: S.unary_limb(sub, a, c, n, rf),
+        jnp.int32(0), p, jnp.bool_(False), jnp.int32(4), jnp.uint32(0x246),
+        name="unary_limb")
+
+
+def test_hlo_step_addressing_path_is_u64_free():
+    p = _u32s(0x55667788, 0x11223344)
+    q = _u32s(0xDEADBEEF, 0x12345678)
+    seg = _u32s(0x1000, 0)
+    _assert_no_u64(
+        lambda d, b, i, s, a32: S.ea_limb(d, b, S._scale_idx_l(i, s), seg,
+                                          a32),
+        p, q, p, jnp.int32(4), jnp.int32(0), name="ea_limb")
+
+
+def test_hlo_uop_lookup_is_u64_free():
+    # probe-only table: the lookup touches hash_tab + rip_l exclusively,
+    # so the unused metadata leaves are u32 dummies (dtype is irrelevant
+    # to the probe; u64 dummies would show up as HLO parameters)
+    cap = 8
+    tab = UopTable(
+        rip_l=jnp.zeros((cap, 2), jnp.uint32),
+        meta_i32=jnp.zeros((cap, 4), jnp.int32),
+        meta_u64=jnp.zeros((cap, 4), jnp.uint32),
+        hash_tab=jnp.full((cap * 4,), -1, jnp.int32),
+    )
+    rip = _u32s(0x1000, 0x14)
+    _assert_no_u64(lambda t, r: S.uop_lookup(t, r), tab, rip,
+                   name="uop_lookup")
+
+
+def test_limb_alu_matches_u64_reference():
+    """alu_limb against a direct u64 recompute of the same semantics —
+    the contract the deleted u64 ALU block used to embody."""
+    rng = np.random.default_rng(0x11B5)
+    k = 256
+    a64 = rng.integers(0, 1 << 64, k, dtype=np.uint64)
+    b64 = rng.integers(0, 1 << 64, k, dtype=np.uint64)
+    a = L.pair(jnp.asarray(a64))
+    b = L.pair(jnp.asarray(b64))
+    for nbytes in (1, 2, 4, 8):
+        m = (1 << (nbytes * 8)) - 1
+        n = jnp.full(k, nbytes, dtype=jnp.int32)
+        for subname, subval, ref in [
+            ("add", 0, lambda x, y: (x + y) & m),
+            ("or", 1, lambda x, y: (x | y) & m),
+            ("adc", 2, lambda x, y: (x + y + 1) & m),
+            ("sbb", 3, lambda x, y: (x - y - 1) & m),
+            ("and", 4, lambda x, y: (x & y) & m),
+            ("sub", 5, lambda x, y: (x - y) & m),
+            ("xor", 6, lambda x, y: (x ^ y) & m),
+            ("cmp", 7, lambda x, y: (x - y) & m),
+        ]:
+            sub = jnp.full(k, subval, dtype=jnp.int32)
+            cin = jnp.full(k, True)
+            am = L.zext(a, n)
+            bm = L.zext(b, n)
+            r, _rf, writes = S.alu_limb(sub, am, bm, cin, n, jnp.uint32(0x2))
+            got = _ints(r)
+            for i in range(k):
+                assert got[i] == ref(int(a64[i]) & m, int(b64[i]) & m), (
+                    f"{subname} n={nbytes} a={a64[i]:#x} b={b64[i]:#x}")
+            assert bool(np.asarray(writes)[0]) == (subname != "cmp")
+
+
+def test_const_shifts_and_small_add():
+    a = _pairs(CORNERS)
+    for k in (0, 1, 7, 31, 32, 33, 63):
+        assert _ints(L.shl64_const(a, k)) == [
+            (x << k) & MASK64 for x in CORNERS], f"shl64_const {k}"
+        assert _ints(L.shr64_const(a, k)) == [
+            x >> k for x in CORNERS], f"shr64_const {k}"
+    for small in (0, 1, 0xFF, 0xFFFFFFFF):
+        s = jnp.full(len(CORNERS), small, dtype=jnp.uint32)
+        assert _ints(L.add64_u32(a, s)) == [
+            (x + small) & MASK64 for x in CORNERS], f"add64_u32 {small:#x}"
+
+
+def test_gpr_write_limb_matches_u64_reference():
+    """The Pallas-bound limb register-file writer against the u64 scatter
+    the step currently uses — same partial-write merge semantics."""
+    from wtf_tpu.cpu import uops as U
+
+    rng = np.random.default_rng(0x6B)
+    file64 = jnp.asarray(rng.integers(0, 1 << 64, 16, dtype=np.uint64))
+    gl = L.unpack_u64(file64)
+    val64 = jnp.uint64(0x1122334455667788)
+    val_l = L.pair(val64)
+    for idx in (0, 3, 15, U.REG_AH_BASE, U.REG_AH_BASE + 3):
+        for nbytes in (1, 2, 4, 8):
+            for cond in (False, True):
+                want = S._gpr_write(file64, jnp.bool_(cond), jnp.int32(idx),
+                                    val64, jnp.int32(nbytes))
+                got = S._gpr_write_l(gl, jnp.bool_(cond), jnp.int32(idx),
+                                     val_l, jnp.int32(nbytes))
+                assert (L.pack_u64(got) == want).all(), (
+                    f"idx={idx} nbytes={nbytes} cond={cond}")
+    text = jax.jit(
+        lambda g, c, i, v, n: S._gpr_write_l(g, c, i, v, n)
+    ).lower(gl, jnp.bool_(True), jnp.int32(3), val_l,
+            jnp.int32(4)).compile().as_text()
+    assert "u64[" not in text and "s64[" not in text

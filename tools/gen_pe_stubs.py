@@ -12,7 +12,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tests"))
 
 from asmhelper import assemble  # noqa: E402
 
-from wtf_tpu.harness.demo_pe import HEAP_STATE  # noqa: E402
+from wtf_tpu.harness.demo_pe import (  # noqa: E402
+    HEAP_BASE, HEAP_PAGES, HEAP_STATE,
+)
+
+HEAP_END = HEAP_BASE + HEAP_PAGES * 0x1000
 
 STUBS = {
     # zero-return: the whole GL/GLU/kernel32/CRT surface
@@ -24,24 +28,47 @@ STUBS = {
     "sqrt": "sqrtsd xmm0, xmm0\nret",
     # malloc(rcx) -> rax: 16-byte-aligned bump allocator over the HEAP
     # arena; the bump pointer lives at HEAP_STATE so overlay reset
-    # rewinds the heap on restore
+    # rewinds the heap on restore.  BOUNDED to the arena: the RAW size is
+    # checked against the arena size FIRST (so sizes like -1 cannot wrap
+    # through the +15 alignment into a tiny allocation), then the bumped
+    # end against HEAP_END — out-of-arena requests return NULL like a
+    # real allocator under pressure, so huge mangled sizes surface as the
+    # TARGET's NULL handling, not as harness-arena overruns misattributed
+    # to gle64 (ADVICE r5).
     "malloc": f"""
         mov r10, {HEAP_STATE}
         mov rax, [r10]
+        mov r11, {HEAP_END - HEAP_BASE}
+        cmp rcx, r11
+        ja fail
         lea rcx, [rcx + 15]
         and rcx, -16
         lea rdx, [rax + rcx]
+        mov r11, {HEAP_END}
+        cmp rdx, r11
+        ja fail
         mov [r10], rdx
+        ret
+    fail:
+        xor eax, eax
         ret
     """,
     # realloc(rcx=old, rdx=size): bump-alloc + copy `size` bytes from the
-    # old block (reads stay inside the mapped arena; realloc(NULL) works)
+    # old block (reads stay inside the mapped arena; realloc(NULL) works).
+    # Same raw-size + arena bounds as malloc: past-the-end growth returns
+    # NULL and leaves the bump pointer (and the old block) untouched.
     "realloc": f"""
         mov r10, {HEAP_STATE}
         mov rax, [r10]
+        mov r11, {HEAP_END - HEAP_BASE}
+        cmp rdx, r11
+        ja rfail
         lea r8, [rdx + 15]
         and r8, -16
         lea r9, [rax + r8]
+        mov r11, {HEAP_END}
+        cmp r9, r11
+        ja rfail
         mov [r10], r9
         mov r9, rdi
         mov r11, rsi
@@ -54,6 +81,9 @@ STUBS = {
     done:
         mov rdi, r9
         mov rsi, r11
+        ret
+    rfail:
+        xor eax, eax
         ret
     """,
     # memset(rcx=dst, dl=val, r8=count) -> dst

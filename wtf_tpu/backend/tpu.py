@@ -395,6 +395,14 @@ class TpuBackend(Backend):
               f"chunks={s['chunks']} decodes={s['decodes']} "
               f"fallbacks={s['fallbacks']} "
               f"smc={s['smc_updates']} bp_dispatches={s['bp_dispatches']}")
+        by_class = s.get("fallbacks_by_opclass", {})
+        if by_class:
+            # attribution for the fallback total (VERDICT r5 item 3):
+            # which instruction classes keep leaving the device path
+            top = ", ".join(
+                f"{name}={count}" for name, count in sorted(
+                    by_class.items(), key=lambda kv: -kv[1])[:10])
+            print(f"[tpu] fallbacks by opclass: {top}")
 
 
 def _result_status(result: TestcaseResult) -> StatusCode:

@@ -361,7 +361,8 @@ def _decode_vex(op: int, cur: _Cursor, pfx: _Prefixes, uop: Uop) -> None:
         # L=1: vzeroall — zeroes the full registers (sub 0).
         # L=0: vzeroupper — zeroes only the upper YMM halves (sub 1);
         #      compilers emit it at AVX/SSE transition points.
-        # Both oracle-serviced.
+        # Both execute on the device step (whole-file xmm limb writes,
+        # step.py OPC_VZEROALL block).
         uop.opc, uop.sub = OPC_VZEROALL, (0 if l_bit else 1)
         return
 

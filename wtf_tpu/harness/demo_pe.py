@@ -54,17 +54,25 @@ POINTS_BASE = 0x2400_0000   # testcase point data; guard page follows
 POINTS_PAGES = 2
 STACK_TOP = 0x0000_7FFF_F000
 
-# Hand-assembled stubs (source in tools/gen_pe_stubs.py); HEAP_STATE is
-# baked into the malloc/realloc immediates.
+# Hand-assembled stubs (source in tools/gen_pe_stubs.py); HEAP_STATE and
+# the HEAP_END arena bound are baked into the malloc/realloc immediates.
+# The RAW size is bounded by the arena size before 16-byte alignment (so
+# sizes like -1 can't wrap through the +15 into a tiny allocation), then
+# the bumped end by HEAP_END — out-of-arena requests return NULL, so
+# allocation-heavy mangled inputs exercise the DLL's NULL-handling
+# instead of crashing on harness-arena overruns that would be
+# misattributed to gle64 (ADVICE r5).
 _STUBS = {
     "ret0": bytes.fromhex("31c0c3"),
     "fpzero": bytes.fromhex("0f57c0c3"),
     "sqrt": bytes.fromhex("f20f51c0c3"),
     "malloc": bytes.fromhex(
-        "49c7c200000023498b02488d490f4883e1f0488d1408498912c3"),
+        "49c7c200000023498b0249c7c3000001004c39d9771c488d490f4883e1f048"
+        "8d140849c7c3000001224c39da7704498912c331c0c3"),
     "realloc": bytes.fromhex(
-        "49c7c200000023498b024c8d420f4983e0f04e8d0c004d890a4989f94989f3"
-        "4889c74889ce4889d14885f67402f3a44c89cf4c89dec3"),
+        "49c7c200000023498b0249c7c3000001004c39da77384c8d420f4983e0f04e"
+        "8d0c0049c7c3000001224d39d977204d890a4989f94989f34889c74889ce48"
+        "89d14885f67402f3a44c89cf4c89dec331c0c3"),
     "memset": bytes.fromhex("4989f94989ca4889cf0fb6c24c89c1f3aa4c89d04c89cfc3"),
 }
 

@@ -12,6 +12,15 @@ resident (GPRs, rip, rflags, XMM0-15, segment bases, control registers,
 syscall MSRs).  The full `CpuState` (x87 stack, debug registers, the other
 16 ZMM...) stays host-side in the snapshot and is restored by construction
 since the device never mutates it.
+
+Hot-state representation: the fields the transition function touches every
+step (GPRs, rip, rflags, the XMM file, fs/gs bases) are stored as explicit
+little-endian u32 limb arrays (`*_l` fields, trailing axis = limb) because
+the TPU has no native 64-bit integers — XLA would otherwise lower every
+u64 op into a u32 pair with carry plumbing the semantics rarely need, and
+the future Pallas step kernel cannot hold u64 at all (interp/limbs.py).
+The u64-named accessors (`machine.gpr`, `.rip`, ...) are free bitcast
+views for host mirrors, tests, and cold device paths.
 """
 
 from __future__ import annotations
@@ -25,22 +34,23 @@ import numpy as np
 
 from wtf_tpu.core.cpustate import CpuState
 from wtf_tpu.core.results import StatusCode
+from wtf_tpu.interp.limbs import pack_u64, unpack_np
 from wtf_tpu.mem.overlay import DirtyOverlay, overlay_init, overlay_reset
 
 
 class Machine(NamedTuple):
     """All fields carry a leading lane axis."""
 
-    # Architectural state
-    gpr: jax.Array        # uint64[L, 16] (x86 encoding order)
-    rip: jax.Array        # uint64[L]
-    rflags: jax.Array     # uint64[L]
-    xmm: jax.Array        # uint64[L, 16, 4] YMM as 4 limbs: device ops
-                          # compute on limbs 0-1; limbs 2-3 (upper YMM)
-                          # are carried for AVX snapshot round-trip
-                          # (reference globals.h:1020-1159 32xZMM)
-    fs_base: jax.Array    # uint64[L]
-    gs_base: jax.Array    # uint64[L]
+    # Architectural hot state, as little-endian u32 limbs (limbs.py)
+    gpr_l: jax.Array      # uint32[L, 16, 2] (x86 encoding order)
+    rip_l: jax.Array      # uint32[L, 2]
+    rflags_l: jax.Array   # uint32[L, 2]
+    xmm_l: jax.Array      # uint32[L, 16, 8] YMM as 8 u32 limbs: device ops
+                          # compute on limbs 0-3 (low XMM); limbs 4-7
+                          # (upper YMM) are carried for AVX snapshot
+                          # round-trip (reference globals.h:1020-1159)
+    fs_base_l: jax.Array  # uint32[L, 2]
+    gs_base_l: jax.Array  # uint32[L, 2]
     kernel_gs_base: jax.Array  # uint64[L]
     cr0: jax.Array        # uint64[L]
     cr2: jax.Array        # uint64[L] (set by host exception delivery)
@@ -81,7 +91,35 @@ class Machine(NamedTuple):
 
     @property
     def n_lanes(self) -> int:
-        return self.rip.shape[0]
+        return self.rip_l.shape[0]
+
+    # -- u64 bitcast views of the limb-packed hot state --------------------
+    # Free reinterprets (no arithmetic); what host mirrors, tests, and the
+    # device step's cold paths read.  Pytree structure is unaffected.
+    @property
+    def gpr(self) -> jax.Array:        # uint64[L, 16]
+        return pack_u64(self.gpr_l)
+
+    @property
+    def rip(self) -> jax.Array:        # uint64[L]
+        return pack_u64(self.rip_l)
+
+    @property
+    def rflags(self) -> jax.Array:     # uint64[L]
+        return pack_u64(self.rflags_l)
+
+    @property
+    def xmm(self) -> jax.Array:        # uint64[L, 16, 4]
+        x = self.xmm_l
+        return pack_u64(x.reshape(x.shape[:-1] + (4, 2)))
+
+    @property
+    def fs_base(self) -> jax.Array:    # uint64[L]
+        return pack_u64(self.fs_base_l)
+
+    @property
+    def gs_base(self) -> jax.Array:    # uint64[L]
+        return pack_u64(self.gs_base_l)
 
 
 def _fpst_f64_bits(v: int) -> int:
@@ -108,6 +146,9 @@ def machine_init(
     def bcast(value: int) -> jax.Array:
         return jnp.asarray(ones * np.uint64(value & (1 << 64) - 1))
 
+    def bcast_l(value: int) -> jax.Array:
+        return jnp.asarray(unpack_np(ones * np.uint64(value & (1 << 64) - 1)))
+
     gpr = np.tile(np.array(cpu.gpr_list(), dtype=np.uint64), (n_lanes, 1))
     xmm = np.zeros((n_lanes, 16, 4), dtype=np.uint64)
     for i in range(16):
@@ -115,12 +156,12 @@ def machine_init(
             xmm[:, i, limb] = np.uint64(cpu.zmm[i][limb])
 
     return Machine(
-        gpr=jnp.asarray(gpr),
-        rip=bcast(cpu.rip),
-        rflags=bcast(cpu.rflags | 0x2),
-        xmm=jnp.asarray(xmm),
-        fs_base=bcast(cpu.fs.base),
-        gs_base=bcast(cpu.gs.base),
+        gpr_l=jnp.asarray(unpack_np(gpr)),
+        rip_l=bcast_l(cpu.rip),
+        rflags_l=bcast_l(cpu.rflags | 0x2),
+        xmm_l=jnp.asarray(unpack_np(xmm).reshape(n_lanes, 16, 8)),
+        fs_base_l=bcast_l(cpu.fs.base),
+        gs_base_l=bcast_l(cpu.gs.base),
         kernel_gs_base=bcast(cpu.kernel_gs_base),
         cr0=bcast(cpu.cr0),
         cr2=bcast(cpu.cr2),
@@ -154,8 +195,24 @@ def machine_init(
     )
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def machine_restore(machine: Machine, snapshot_template: Machine) -> Machine:
+def _machine_restore_impl(machine: Machine,
+                          snapshot_template: Machine) -> Machine:
+    return snapshot_template._replace(
+        # Keep the overlay *storage* from the live machine so no new buffers
+        # are allocated; overlay_reset rebuilds just the indexing state.
+        overlay=overlay_reset(machine.overlay),
+        cov=jnp.zeros_like(machine.cov),
+        edge=jnp.zeros_like(machine.edge),
+    )
+
+
+_machine_restore_donated = partial(
+    jax.jit, donate_argnums=(0,))(_machine_restore_impl)
+_machine_restore_plain = jax.jit(_machine_restore_impl)
+
+
+def machine_restore(machine: Machine, snapshot_template: Machine,
+                    donate: bool = None) -> Machine:
     """Restore(): every lane back to the snapshot.  O(1) in guest memory —
     replaces the reference's dirty-page rewrite loops (SURVEY.md §5.4).
 
@@ -165,15 +222,17 @@ def machine_restore(machine: Machine, snapshot_template: Machine) -> Machine:
     build the template with `overlay_slots=0` to avoid holding a second
     multi-GiB overlay buffer alive.
 
-    Donation: `machine` is donated so the overlay storage is reset in
-    place (no copy of the [lanes, slots, 4096] buffer).  The template is
-    NOT donated — XLA copies its leaves into the output, so the result
-    never aliases the template and later run_chunk calls may donate the
-    machine freely."""
-    return snapshot_template._replace(
-        # Keep the overlay *storage* from the live machine so no new buffers
-        # are allocated; overlay_reset rebuilds just the indexing state.
-        overlay=overlay_reset(machine.overlay),
-        cov=jnp.zeros_like(machine.cov),
-        edge=jnp.zeros_like(machine.edge),
-    )
+    Donation (donate=True, the off-CPU hot path): `machine` is donated so
+    the overlay storage is reset in place (no copy of the
+    [lanes, slots, 4096] buffer).  The template is NOT donated — XLA
+    copies its leaves into the output, so the result never aliases the
+    template and later run_chunk calls may donate the machine freely.
+    On the CPU backend donation must stay OFF: XLA CPU's buffer reuse
+    for donated inputs corrupts live machine leaves on this graph
+    (interp/step.py make_run_chunk documents the failure mode).  The
+    default (donate=None) resolves to that policy lazily, exactly like
+    make_run_chunk."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    fn = _machine_restore_donated if donate else _machine_restore_plain
+    return fn(machine, snapshot_template)

@@ -41,6 +41,7 @@ from wtf_tpu.cpu.emu import (
 from wtf_tpu.cpu.interrupts import (
     VEC_DE, DeliveryFailed, deliver_exception, deliver_page_fault,
 )
+from wtf_tpu.interp import limbs
 from wtf_tpu.interp.machine import Machine, machine_init, machine_restore
 from wtf_tpu.interp.step import make_run_chunk
 from wtf_tpu.interp.uoptable import DecodeCache
@@ -48,12 +49,21 @@ from wtf_tpu.snapshot.loader import Snapshot
 
 MASK64 = (1 << 64) - 1
 
+# opc int -> lowercase class name ("alu", "ssefp", ...) for fallback stats
+_OPC_NAMES = {
+    value: name[len("OPC_"):].lower()
+    for name, value in vars(U).items() if name.startswith("OPC_")
+}
+
 PTE_P = 1
 PTE_W = 1 << 1
 PTE_PS = 1 << 7
 PHYS_MASK = 0x000F_FFFF_FFFF_F000
 
 # Machine leaves mirrored into HostView (everything except overlay/cov/edge).
+# The limb-packed hot fields (machine.py) are exposed to ALL host code as
+# u64 views under their architectural names — HostView packs on pull and
+# unpacks on push, so the seam lives in exactly two places.
 _MIRROR_FIELDS = (
     "gpr", "rip", "rflags", "xmm", "fs_base", "gs_base", "kernel_gs_base",
     "cr0", "cr2", "cr3", "cr4", "cr8", "cs", "ss",
@@ -61,6 +71,34 @@ _MIRROR_FIELDS = (
     "fpst", "fpcw", "fpsw", "fptw", "mxcsr",
     "status", "icount", "rdrand", "bp_skip", "fault_gva", "fault_write",
 )
+
+# host mirror name -> u32-limb Machine field
+_LIMB_FIELDS = {
+    "gpr": "gpr_l", "rip": "rip_l", "rflags": "rflags_l", "xmm": "xmm_l",
+    "fs_base": "fs_base_l", "gs_base": "gs_base_l",
+}
+
+
+def _pack_mirror(name: str, arr: np.ndarray) -> np.ndarray:
+    """Device limb array -> host u64 mirror (xmm pairs its 8 limbs to 4)."""
+    if name == "xmm":
+        arr = arr.reshape(arr.shape[:-1] + (4, 2))
+    return np.array(limbs.pack_np(arr))
+
+
+def _unpack_mirror(name: str, arr: np.ndarray) -> np.ndarray:
+    """Host u64 mirror -> device limb array.
+
+    Returns a fresh copy, never a view: the result is uploaded into the
+    machine, whose buffers are DONATED to the next chunk — on the CPU
+    backend jnp.asarray can zero-copy alias host numpy memory, and a
+    donated alias of a still-mutable HostView array is silent corruption
+    (observed as garbage status/fpsw reads under multi-test processes).
+    """
+    u = limbs.unpack_np(arr)
+    if name == "xmm":
+        u = u.reshape(u.shape[:-2] + (8,))
+    return u.copy()
 
 
 class HostFault(Exception):
@@ -89,11 +127,16 @@ class HostView:
         # per-field pull costs a device round trip each — 22 RPCs per
         # servicing round over a remote-TPU tunnel)
         host = jax.device_get(
-            {name: getattr(m, name) for name in _MIRROR_FIELDS}
+            {name: getattr(m, _LIMB_FIELDS.get(name, name))
+             for name in _MIRROR_FIELDS}
             | {"__ov_pfn": m.overlay.pfn})
-        # np.array: device_get may hand back read-only views; handlers mutate
+        # np.array: device_get may hand back read-only views; handlers
+        # mutate.  Limb-packed fields convert to u64 views here (pack_np)
+        # so every host consumer keeps architectural u64 semantics.
         self.r: Dict[str, np.ndarray] = {
-            name: np.array(host[name]) for name in _MIRROR_FIELDS
+            name: (_pack_mirror(name, np.asarray(host[name]))
+                   if name in _LIMB_FIELDS else np.array(host[name]))
+            for name in _MIRROR_FIELDS
         }
         # overlay index pulled once; data rows fetched lazily per (lane, pfn)
         self._ov_pfn = host["__ov_pfn"]
@@ -394,14 +437,14 @@ def _writeback_lane(view: HostView, lane: int, cpu: EmuCpu) -> None:
         view.r["xmm"][lane, i, 3] = np.uint64(cpu.ymmh[i][1] & MASK64)
 
 
-@partial(jax.jit, donate_argnums=(0,))
 def _apply_page_writes(machine: Machine, lanes, pfns, pages, ok_mask):
     """Apply K buffered (lane, pfn, page) writes into the batched overlay in
     one device call (lax.scan; K is padded to a bucket size host-side).
 
-    The machine is donated (overlay mutates in place); machine_restore
-    copies template leaves so the live machine never aliases the pristine
-    template."""
+    Jitted below in a donated variant (overlay mutates in place; off-CPU
+    hot path) and a plain one (CPU — donation is unsound there, see
+    make_run_chunk); machine_restore copies template leaves so the live
+    machine never aliases the pristine template."""
     capacity = machine.overlay.pfn.shape[1]
 
     def body(overlay, item):
@@ -437,6 +480,11 @@ def _apply_page_writes(machine: Machine, lanes, pfns, pages, ok_mask):
         & (machine.status == jnp.int32(int(StatusCode.RUNNING))),
         jnp.int32(int(StatusCode.OVERLAY_FULL)), machine.status)
     return machine._replace(overlay=overlay, status=status)
+
+
+_apply_page_writes_donated = partial(
+    jax.jit, donate_argnums=(0,))(_apply_page_writes)
+_apply_page_writes_plain = jax.jit(_apply_page_writes)
 
 
 class Runner:
@@ -475,7 +523,11 @@ class Runner:
         if deliver_exceptions is None:
             deliver_exceptions = snapshot.cpu.idtr.limit > 0
         self.deliver_exceptions = deliver_exceptions
-        self._run_chunk = make_run_chunk(chunk_steps)
+        # Donation only off-CPU: XLA CPU miscompiles donated machines on
+        # this graph (see make_run_chunk's caveat) and donation buys
+        # nothing on a host backend anyway.
+        self._donate = jax.default_backend() != "cpu"
+        self._run_chunk = make_run_chunk(chunk_steps, donate=self._donate)
         self.lane_errors: Dict[int, str] = {}
         self._smc_updates: Dict[int, int] = {}
         # Adaptive chunk growth for deep executions (BASELINE config 5 is
@@ -507,12 +559,16 @@ class Runner:
         # the next push
         self._pending_cov: List[Tuple[int, int]] = []
         self._pending_edge: List[Tuple[int, int]] = []
-        # run statistics (reference PrintRunStats role, backend.h:218)
+        # run statistics (reference PrintRunStats role, backend.h:218).
+        # fallbacks_by_opclass: oracle single-steps keyed by the uop's
+        # opcode class name, so campaign output can attribute WHY lanes
+        # left the device path (VERDICT r5 item 3).
         self.stats = {
             "chunks": 0, "decodes": 0, "decodes_prefetched": 0,
             "fallbacks": 0, "fallback_burst_steps": 0, "smc_updates": 0,
             "bp_dispatches": 0, "exceptions_delivered": 0,
             "max_chunk_steps": chunk_steps,
+            "fallbacks_by_opclass": {},
         }
 
     # -- host memory access ------------------------------------------------
@@ -523,7 +579,10 @@ class Runner:
         """Apply a HostView's mutations (registers + buffered page writes +
         burst coverage bits) back to the device batch."""
         updates = {
-            name: jnp.asarray(view.r[name]) for name in _MIRROR_FIELDS
+            _LIMB_FIELDS.get(name, name): jnp.asarray(
+                _unpack_mirror(name, view.r[name])
+                if name in _LIMB_FIELDS else view.r[name])
+            for name in _MIRROR_FIELDS
         }
         self.machine = self.machine._replace(**updates)
         def _apply_bits(bitmap, pending):
@@ -561,7 +620,9 @@ class Runner:
                 pfns[j] = pfn
                 pages[j] = np.frombuffer(bytes(page), dtype=np.uint8)
                 valid[j] = True
-            self.machine = _apply_page_writes(
+            apply_writes = (_apply_page_writes_donated if self._donate
+                            else _apply_page_writes_plain)
+            self.machine = apply_writes(
                 self.machine, jnp.asarray(lanes), jnp.asarray(pfns),
                 jnp.asarray(pages.view(np.uint64)), jnp.asarray(valid))
             view.pending.clear()
@@ -685,6 +746,14 @@ class Runner:
         """Single-step one lane on the EmuCpu oracle (the host slow path for
         instructions outside the device subset)."""
         self.stats["fallbacks"] += 1
+        # per-opclass attribution (VERDICT r5 item 3: a campaign's fallback
+        # total was a single opaque number — e.g. real_pe's 1321 — with no
+        # way to tell WHICH instruction classes keep diverting)
+        uop = self.cache.uops.get(view.get_rip(lane))
+        opclass = (_OPC_NAMES.get(uop.opc, f"opc{uop.opc}")
+                   if uop is not None else "undecoded")
+        by_class = self.stats["fallbacks_by_opclass"]
+        by_class[opclass] = by_class.get(opclass, 0) + 1
         cpu_state = _lane_cpu_state(view, lane, self.cpu0)
         emu = EmuCpu(_FallbackMem(view, lane), cpu_state)
         emu.icount = int(view.r["icount"][lane])
@@ -734,6 +803,11 @@ class Runner:
     ))
 
     _BRANCH_OPCS = frozenset((U.OPC_JMP, U.OPC_JCC, U.OPC_CALL, U.OPC_RET))
+
+    # statuses whose oracle step COMMITTED (rip advanced): the edge-hash
+    # bit is owed even when the run stops right after the branch
+    _COMMITTED_STATUSES = frozenset((
+        StatusCode.RUNNING, StatusCode.TIMEDOUT, StatusCode.CR3_CHANGE))
 
     def _entry_at(self, view: HostView, lane: int,
                   rip: int) -> Optional[Tuple[int, "U.Uop"]]:
@@ -786,6 +860,17 @@ class Runner:
         # chronic lane across the device-class glue between diverting
         # instructions (denormal FP every few ops), not to steal long
         # normal stretches from the device, which executes them faster.
+        #
+        # FP-reproducibility caveat: this tier runs device-class SSE/x87
+        # FP on the host oracle (numpy).  On the CPU backend both engines
+        # are IEEE bit-exact, but on a real TPU the device's div/sqrt
+        # rounding is the platform's (the documented fast-path fidelity
+        # delta, step.py SSE-FP block) — so WHERE an instruction executes
+        # can change low FP bits there.  A crash found through a burst
+        # therefore reproduces under `--backend=emu` (all-oracle) but a
+        # TPU re-run of the same input may divert at different points.
+        # The tier is off on CPU (burst_any_tier) and bounded here, so
+        # the exposure is a 24-instruction window per chronic round.
         any_budget = 24 if (streak >= 4 and self.burst_any_tier) else 0
         ebits = self.machine.edge.shape[1] * 32
         from wtf_tpu.utils.hashing import mix64
@@ -805,10 +890,15 @@ class Runner:
                     return
                 any_budget -= 1
             self._fallback_step(view, lane)
-            # the coverage/edge bits the device dispatch would have set
+            # the coverage/edge bits the device dispatch would have set.
+            # TIMEDOUT/CR3_CHANGE are set AFTER the oracle committed the
+            # step (the branch executed; only the run stops afterwards),
+            # so those statuses still record the edge — the device path
+            # likewise sets edge bits on a committing step that trips the
+            # instruction budget (exact-parity claim in the docstring).
             self._pending_cov.append((lane, idx))
             if (uop.opc in self._BRANCH_OPCS
-                    and view.get_status(lane) == StatusCode.RUNNING):
+                    and view.get_status(lane) in self._COMMITTED_STATUSES):
                 eh = mix64(rip) ^ view.get_rip(lane)
                 self._pending_edge.append((lane, eh & (ebits - 1)))
             self.stats["fallback_burst_steps"] += 1
@@ -870,12 +960,16 @@ class Runner:
                     if self.adaptive_chunks else self.chunk_steps)
             self.stats["max_chunk_steps"] = max(
                 self.stats["max_chunk_steps"], size)
-            run_chunk = (make_run_chunk(size)
+            run_chunk = (make_run_chunk(size, donate=self._donate)
                          if self.adaptive_chunks else self._run_chunk)
             self.machine = run_chunk(
                 tab, self.physmem.image, self.machine, limit)
             self.stats["chunks"] += 1
-            status = np.asarray(self.machine.status)
+            # COPY, never a zero-copy view: the machine's buffers are
+            # donated into the next chunk call, and a live numpy view of
+            # a donated CPU buffer reads whatever XLA reuses the memory
+            # for (seen as garbage status/fpsw under multi-test processes)
+            status = np.array(jax.device_get(self.machine.status))
             running = status == int(StatusCode.RUNNING)
             need = {
                 int(StatusCode.NEED_DECODE): [],
@@ -955,7 +1049,8 @@ class Runner:
         """Every lane back to the snapshot: O(1) overlay reset + register
         broadcast (replaces the reference's dirty-page rewrite loops,
         SURVEY.md §5.4)."""
-        self.machine = machine_restore(self.machine, self.template)
+        self.machine = machine_restore(self.machine, self.template,
+                                       donate=self._donate)
         self.lane_errors.clear()
         self._pending_cov.clear()
         self._pending_edge.clear()
@@ -965,7 +1060,8 @@ class Runner:
         self._smc_updates.clear()
 
     def statuses(self) -> np.ndarray:
-        return np.asarray(self.machine.status)
+        # copy, not a view — see the donation note in run()
+        return np.array(jax.device_get(self.machine.status))
 
 
 def warm_decode_cache(runner: Runner, target, payload: bytes,

@@ -25,6 +25,16 @@ Anything the device path does not implement surfaces as per-lane UNSUPPORTED
 and is single-stepped on the host by the EmuCpu oracle (interp/runner.py) —
 the same "precise slow path backs a fast path" split the reference gets from
 bochscpu vs KVM, collapsed into one machine.
+
+Representation: the hot machine state is u32 limb pairs (interp/limbs.py;
+TPU has no native 64-bit integers, and the future Pallas kernel cannot hold
+them at all).  The ported paths — decode-cache hash probe, integer ALU and
+unary ops, flag images, effective addressing, condition evaluation, and the
+fallthrough/Jcc rip updates — run entirely on u32 limbs (`alu_limb`,
+`unary_limb`, `ea_limb` below are compiled standalone by tests/test_limbs.py
+to pin the absence of 64-bit ops).  Cold classes (shifts, mul/div, strings,
+SSE/x87, syscalls, the memory/paging subsystem) read u64 bitcast views and
+convert back at the pack_u64/unpack_u64 seam, which XLA lowers for free.
 """
 
 from __future__ import annotations
@@ -41,6 +51,7 @@ from wtf_tpu.core.results import StatusCode
 from wtf_tpu.cpu import uops as U
 from wtf_tpu.cpu.emu import MSR_ATTR
 from wtf_tpu.cpu.cpuid import CPUID_TABLE, MAX_BASIC_LEAF
+from wtf_tpu.interp import limbs as L
 from wtf_tpu.interp.machine import Machine
 from wtf_tpu.interp.uoptable import (
     F_A32,
@@ -52,7 +63,7 @@ from wtf_tpu.interp.uoptable import (
 from wtf_tpu.mem.overlay import (
     extract_pair, load_windows3_vec, store_window3,
 )
-from wtf_tpu.mem.paging import Translation, translate_vec
+from wtf_tpu.mem.paging import Translation, translate_vec_l
 from wtf_tpu.mem.physmem import MemImage
 
 MASK64 = (1 << 64) - 1
@@ -217,20 +228,8 @@ def _flags_logic(r, opsize):
     )
 
 
-def _eval_cond(rf, rcx, cc):
-    cf = (rf & _u(_CF)) != 0
-    pf = (rf & _u(_PF)) != 0
-    zf = (rf & _u(_ZF)) != 0
-    sf = (rf & _u(_SF)) != 0
-    of = (rf & _u(_OF)) != 0
-    conds = jnp.stack([
-        of, ~of, cf, ~cf, zf, ~zf, cf | zf, ~(cf | zf),
-        sf, ~sf, pf, ~pf, sf != of, sf == of,
-        zf | (sf != of), ~zf & (sf == of),
-    ])
-    base = conds[jnp.clip(cc, 0, 15)]
-    base = jnp.where(cc == 16, rcx == _u(0), base)  # jrcxz
-    return jnp.where(cc == 17, (rcx & _u(0xFFFFFFFF)) == _u(0), base)  # jecxz
+# (condition evaluation lives in limbs.eval_cond — the arithmetic flags all
+# sit in the low rflags limb, so the ported path is u32-only by nature)
 
 
 # ---------------------------------------------------------------------------
@@ -266,6 +265,142 @@ def _gpr_write(gpr, cond, idx, val, nbytes):
 
 
 # ---------------------------------------------------------------------------
+# limb register file helpers (the u32-packed mirror of the three above;
+# `gl` is the uint32[16, 2] per-lane file)
+# ---------------------------------------------------------------------------
+
+def _z32():
+    return jnp.uint32(0)
+
+
+def _read64_l(gl, idx):
+    """Full qword read as a limb pair; REG_NONE (out-of-file) reads 0."""
+    ok = (idx >= 0) & (idx < 16)
+    row = gl[jnp.clip(idx, 0, 15)]
+    z = _z32()
+    return jnp.where(ok, row[0], z), jnp.where(ok, row[1], z)
+
+
+def _read_reg_l(gl, idx, nbytes):
+    high = idx >= U.REG_AH_BASE
+    base = jnp.clip(jnp.where(high, idx - U.REG_AH_BASE, idx), 0, 15)
+    row = gl[base]
+    lo, hi = L.zext((row[0], row[1]), nbytes)
+    ah = (row[0] >> 8) & jnp.uint32(0xFF)
+    return jnp.where(high, ah, lo), jnp.where(high, _z32(), hi)
+
+
+def _gpr_write_l(gl, cond, idx, val, nbytes):
+    """_gpr_write on the limb file: 32-bit writes zero the high limb,
+    8/16-bit writes merge into the low limb, AH-views hit bits 15:8.
+
+    Not called by step_lane (the one shared u64 scatter is cheaper while
+    the file lives behind a free bitcast) — this is the register-file
+    writer for the Pallas fused-step kernel, where no u64 file can exist;
+    tests/test_limbs.py pins it against _gpr_write."""
+    high = idx >= U.REG_AH_BASE
+    base = jnp.clip(jnp.where(high, idx - U.REG_AH_BASE, idx), 0, 15)
+    old_lo, old_hi = gl[base, 0], gl[base, 1]
+    mlo, _mhi = L.size_mask(nbytes)
+    ah_merged = ((old_lo & jnp.uint32(0xFFFF00FF))
+                 | ((val[0] & jnp.uint32(0xFF)) << 8))
+    lo = jnp.where(high, ah_merged,
+                   jnp.where(nbytes >= 4, val[0],
+                             (old_lo & ~mlo) | (val[0] & mlo)))
+    hi = jnp.where(high, old_hi,
+                   jnp.where(nbytes >= 8, val[1],
+                             jnp.where(nbytes == 4, _z32(), old_hi)))
+    lo = jnp.where(cond, lo, old_lo)
+    hi = jnp.where(cond, hi, old_hi)
+    return gl.at[base].set(jnp.stack([lo, hi]))
+
+
+# ---------------------------------------------------------------------------
+# ported hot paths (pure u32 limb arithmetic — tests/test_limbs.py compiles
+# these standalone and fails if a 64-bit integer op appears in the HLO)
+# ---------------------------------------------------------------------------
+
+def alu_limb(sub, a, b, cf_in, opsize, rf_lo):
+    """Integer ALU class on u32 limbs: add/adc/sub/sbb/cmp/and/or/xor/test
+    plus the CF/PF/AF/ZF/SF/OF image — semantics mirror cpu/emu.py exactly
+    (the same contract the deleted u64 block carried).
+
+    Returns (masked result pair, new low-rflags limb, writes-result)."""
+    r_add = L.zext(L.add64(a, b), opsize)
+    r_adc = L.zext(L.adc64(a, b, cf_in)[0], opsize)
+    r_sub = L.zext(L.sub64(a, b), opsize)
+    r_sbb = L.zext(L.sbb64(a, b, cf_in)[0], opsize)
+    r_and, r_or, r_xor = L.and64(a, b), L.or64(a, b), L.xor64(a, b)
+    zero = (_z32(), _z32())
+    r = L.select64(
+        [sub == U.ALU_ADD, sub == U.ALU_ADC, sub == U.ALU_SUB,
+         sub == U.ALU_SBB, sub == U.ALU_CMP, sub == U.ALU_AND,
+         sub == U.ALU_OR, sub == U.ALU_XOR, sub == U.ALU_TEST],
+        [r_add, r_adc, r_sub, r_sbb, r_sub, r_and, r_or, r_xor, r_and],
+        zero)
+    fl_add = L.flags_add(a, b, r, opsize, (sub == U.ALU_ADC) & cf_in)
+    fl_sub = L.flags_sub(a, b, r, opsize, (sub == U.ALU_SBB) & cf_in)
+    fl_logic = L.flags_logic(r, opsize)
+    is_add = (sub == U.ALU_ADD) | (sub == U.ALU_ADC)
+    is_sub = (sub == U.ALU_SUB) | (sub == U.ALU_SBB) | (sub == U.ALU_CMP)
+    fl = jnp.where(is_add, fl_add, jnp.where(is_sub, fl_sub, fl_logic))
+    new_rf_lo = (rf_lo & jnp.uint32(~L.FLAGS_ARITH & 0xFFFFFFFF)) | fl
+    writes = ~((sub == U.ALU_CMP) | (sub == U.ALU_TEST))
+    return r, new_rf_lo, writes
+
+
+def unary_limb(sub, a, cf_in, opsize, rf_lo):
+    """inc/dec/not/neg on u32 limbs (inc/dec preserve CF; neg CF = a != 0;
+    not leaves rflags alone) — mirrors the deleted u64 UNARY block."""
+    one = (jnp.uint32(1), _z32())
+    zero = (_z32(), _z32())
+    r_inc = L.zext(L.add64(a, one), opsize)
+    r_dec = L.zext(L.sub64(a, one), opsize)
+    r_neg = L.zext(L.neg64(a), opsize)
+    r_not = L.zext(L.not64(a), opsize)
+    r = L.select64(
+        [sub == U.UN_INC, sub == U.UN_DEC, sub == U.UN_NOT, sub == U.UN_NEG],
+        [r_inc, r_dec, r_not, r_neg], zero)
+    false = jnp.bool_(False)
+    fl = jnp.where(
+        sub == U.UN_INC, L.flags_add(a, one, r_inc, opsize, false),
+        jnp.where(sub == U.UN_DEC, L.flags_sub(a, one, r_dec, opsize, false),
+                  L.flags_sub(zero, a, r_neg, opsize, false)))
+    cf = jnp.where((sub == U.UN_INC) | (sub == U.UN_DEC), cf_in,
+                   ~L.is_zero64(L.zext(a, opsize)))
+    new_rf_lo = jnp.where(
+        sub == U.UN_NOT, rf_lo,
+        (rf_lo & jnp.uint32(~L.FLAGS_ARITH & 0xFFFFFFFF))
+        | (fl & jnp.uint32(~L.CF & 0xFFFFFFFF))
+        | jnp.where(cf, jnp.uint32(L.CF), _z32()))
+    return r, new_rf_lo
+
+
+def _scale_idx_l(v, scale):
+    """index * scale for SIB scales {0,1,2,4,8} as a limb shift (where-
+    chain, not jnp.select — select's case index would reintroduce s64).
+    The shift is at most 3, so the cross-limb carry needs no >=32 cases
+    (and lg==0 makes the 32-lg carry shift a harmless full shift-out)."""
+    lg = jnp.where(scale == 2, jnp.uint32(1),
+                   jnp.where(scale == 4, jnp.uint32(2),
+                             jnp.where(scale == 8, jnp.uint32(3), _z32())))
+    carry = jnp.where(lg == 0, _z32(), v[0] >> (jnp.uint32(32) - lg))
+    lo, hi = v[0] << lg, (v[1] << lg) | carry
+    keep = scale != 0
+    return jnp.where(keep, lo, _z32()), jnp.where(keep, hi, _z32())
+
+
+def ea_limb(disp, base, idx_scaled, seg, a32):
+    """Effective address on u32 limbs: disp + base + scaled index, 67h
+    truncation to 32 bits BEFORE the segment base (SDM address-size
+    override in 64-bit mode — the truncation is literally zeroing the
+    high limb, the representation's home turf)."""
+    flat_lo, flat_hi = L.add64(L.add64(disp, base), idx_scaled)
+    flat_hi = jnp.where(a32 != 0, _z32(), flat_hi)
+    return L.add64((flat_lo, flat_hi), seg)
+
+
+# ---------------------------------------------------------------------------
 # memory spans (dynamic size <= 16 bytes, overlay-aware, two pages max)
 #
 # Word-window design: any <=16-byte span is covered by 3 aligned u64 words
@@ -297,17 +432,29 @@ def _pack_pair(b16):
 # the transition function
 # ---------------------------------------------------------------------------
 
-def uop_lookup(tab: UopTable, rip):
+def uop_lookup(tab: UopTable, rip_l):
     """Open-addressed probe (host inserter bounds chains to PROBES) ->
     entry index or -1 (NEED_DECODE).  All PROBES slots are fetched in one
-    gather pair (probe count is a latency, not a work, concern on TPU)."""
-    hmask = _u(tab.hash_tab.shape[0] - 1)
-    h = _splitmix64(rip)
-    slots = ((h + jnp.arange(PROBES, dtype=jnp.uint64)) & hmask).astype(jnp.int32)
+    gather pair (probe count is a latency, not a work, concern on TPU).
+
+    Ported path: `rip_l` is a u32 limb pair and the whole probe — the
+    splitmix64 hash, the slot indices, the rip verification compare — is
+    u32-only (the table mask always fits 32 bits, so slot = (hash + k) &
+    mask needs only the low hash limb)."""
+    hmask = jnp.uint32(tab.hash_tab.shape[0] - 1)
+    h_lo, _h_hi = L.splitmix64(rip_l)
+    slots = ((h_lo + jnp.arange(PROBES, dtype=jnp.uint32))
+             & hmask).astype(jnp.int32)
     e = tab.hash_tab[slots]
-    match = (e >= 0) & (tab.rip[jnp.maximum(e, 0)] == rip)
-    first = jnp.argmax(match)
-    return jnp.where(jnp.any(match), e[first], jnp.int32(-1))
+    er = tab.rip_l[jnp.maximum(e, 0)]
+    match = (e >= 0) & (er[:, 0] == rip_l[0]) & (er[:, 1] == rip_l[1])
+    # first-match via i32 min-rank (argmax's reduce runs an s64 iota under
+    # x64, which would be the probe's only 64-bit op)
+    rank = jnp.where(match, jnp.arange(PROBES, dtype=jnp.int32),
+                     jnp.int32(PROBES))
+    first = jnp.min(rank)
+    return jnp.where(first < PROBES,
+                     e[jnp.minimum(first, PROBES - 1)], jnp.int32(-1))
 
 
 def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
@@ -317,11 +464,16 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     budget (u64; 0 = unlimited) -> TIMEDOUT, the deterministic equivalent of
     the reference's after_execution counter (bochscpu_backend.cc:458-469)."""
     enabled = st.status == jnp.int32(int(StatusCode.RUNNING))
+    # limb-packed hot state (ported paths) + free u64 bitcast views (cold
+    # paths and the memory subsystem convert at this seam)
+    glimb = st.gpr_l                                  # uint32[16, 2]
+    rip_l = (st.rip_l[0], st.rip_l[1])
+    rf_lo, rf_hi = st.rflags_l[0], st.rflags_l[1]
     gpr, rip, rf = st.gpr, st.rip, st.rflags
     overlay = st.overlay
 
-    # -- 1. decode-cache lookup -----------------------------------------
-    idx = uop_lookup(tab, rip)
+    # -- 1. decode-cache lookup (u32-only hash probe) -------------------
+    idx = uop_lookup(tab, rip_l)
     miss = enabled & (idx < 0)
     idxc = jnp.maximum(idx, 0)
 
@@ -345,10 +497,13 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     rep = f[F_REP]
     disp = mu[MU_DISP]
     imm = mu[MU_IMM]
+    disp_l = L.pair(disp)
+    imm_l = L.pair(imm)
 
     opmask = _size_mask(opsize)
     bits_u = opsize.astype(jnp.uint64) * _u(8)
-    next_rip = rip + length.astype(jnp.uint64)
+    next_rip_l = L.add64_u32(rip_l, length.astype(jnp.uint32))
+    next_rip = L.to_u64(next_rip_l)
 
     # -- 2. breakpoint (pre-execution, like BeforeExecutionHook dispatch) --
     at_bp = enabled & ~miss & (f[M_BP] == 1) & (st.bp_skip == 0)
@@ -461,16 +616,17 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         | (is_string & (f[F_A32] != 0))
         | movcr_bad | div64_hard)
 
-    # -- 4a. effective address -------------------------------------------
-    base_val = jnp.where(breg == U.REG_RIP, next_rip, _read64(gpr, breg))
-    idx_val = _read64(gpr, ireg) * scale.astype(jnp.uint64)
-    seg_base = jnp.where(seg == U.SEG_FS, st.fs_base,
-                         jnp.where(seg == U.SEG_GS, st.gs_base, _u(0)))
-    # 67h: the un-segmented EA truncates to 32 bits BEFORE the segment
-    # base is applied (SDM address-size override in 64-bit mode)
-    ea_flat = disp + base_val + idx_val
-    ea_flat = jnp.where(f[F_A32] != 0, ea_flat & _u(0xFFFF_FFFF), ea_flat)
-    ea = ea_flat + seg_base
+    # -- 4a. effective address (ported: u32 limbs end to end) -------------
+    base_val_l = L.where64(breg == U.REG_RIP, next_rip_l,
+                           _read64_l(glimb, breg))
+    idx_val_l = _scale_idx_l(_read64_l(glimb, ireg), scale)
+    seg_base_l = L.select64(
+        [seg == U.SEG_FS, seg == U.SEG_GS],
+        [(st.fs_base_l[0], st.fs_base_l[1]),
+         (st.gs_base_l[0], st.gs_base_l[1])],
+        (jnp.uint32(0), jnp.uint32(0)))
+    ea_l = ea_limb(disp_l, base_val_l, idx_val_l, seg_base_l, f[F_A32])
+    ea = L.to_u64(ea_l)
 
     # BT bit-string addressing: register bit index moves the EA by opsize
     # for every `bits` of signed offset (emu _exec_bt).
@@ -483,17 +639,25 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     ea = jnp.where(bt_mem_reg, ea + bt_adjust, ea)
     bt_off = bt_signed & (bits_u - _u(1))
 
-    # -- 4b. memory roles -------------------------------------------------
+    # -- 4b. memory roles (ported: span addresses assemble in u32 limbs;
+    # the page walk itself converts at the translate_vec_l seam) ----------
     rsp, rbp, rsi, rdi = gpr[4], gpr[5], gpr[6], gpr[7]
+    rsp_l = (glimb[4, 0], glimb[4, 1])
+    rbp_l = (glimb[5, 0], glimb[5, 1])
+    rsi_l = (glimb[6, 0], glimb[6, 1])
+    rdi_l = (glimb[7, 0], glimb[7, 1])
+    # post-BT-adjust EA (the BT bit-string displacement stays u64-cold)
+    ea_mem_l = L.pair(ea)
     srcsize = jnp.where(srcsize0 == 0, opsize, srcsize0)
 
     l1_need = pre_live & ~unsupported & ~rep_skip & (
         ((sk == U.K_MEM) & ~x87_store) | is_pop | is_popf | is_ret
         | is_leave | s_movs | s_lods | s_cmps | s_scas)
-    l1_addr = jnp.where(s_movs | s_lods | s_cmps, rsi,
-               jnp.where(s_scas, rdi,
-                jnp.where(is_pop | is_popf | is_ret, rsp,
-                 jnp.where(is_leave, rbp, ea))))
+    l1_addr_l = L.select64(
+        [s_movs | s_lods | s_cmps, s_scas, is_pop | is_popf | is_ret,
+         is_leave],
+        [rsi_l, rdi_l, rsp_l, rbp_l], ea_mem_l)
+    l1_addr = L.to_u64(l1_addr_l)
     l1_size = jnp.where(is_popf | is_ret | is_leave, 8,
                jnp.where(is_pop | is_string | is_sse, opsize,
                 jnp.where(is_ssefp, fp_ldsize, srcsize)))
@@ -504,17 +668,21 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     store_only = is_(U.OPC_MOV) | is_(U.OPC_SETCC) | is_pop
     l2_need = pre_live & ~unsupported & ~rep_skip & (
         ((dk == U.K_MEM) & ~is_sse & ~store_only) | s_cmps)
-    l2_addr = jnp.where(s_cmps, rdi, ea)
+    l2_addr_l = L.where64(s_cmps, rdi_l, ea_mem_l)
+    l2_addr = L.to_u64(l2_addr_l)
     l2_size = opsize
 
     # store address/size (the store itself commits at the end of the step;
     # computing its span here lets its translation batch with the loads')
     push_size = jnp.where(is_pushf | is_call, jnp.int32(8), opsize)
-    st_addr = opc_list([
-        (is_push | is_pushf | is_call, rsp - push_size.astype(jnp.uint64)),
-        (is_enter, rsp - _u(8)),
-        (s_movs | s_stos, rdi),
-    ], ea)
+    push_size_l = (push_size.astype(jnp.uint32), jnp.uint32(0))
+    st_addr_l = L.select64(
+        [is_push | is_pushf | is_call, is_enter, s_movs | s_stos],
+        [L.sub64(rsp_l, push_size_l),
+         L.sub64(rsp_l, (jnp.uint32(8), jnp.uint32(0))),
+         rdi_l],
+        ea_mem_l)
+    st_addr = L.to_u64(st_addr_l)
     # stores and pushes span the same byte count; x87 stores their
     # operand width (fst m32/m64, fist m16/32/64, fnstcw/fnstsw m16,
     # stmxcsr m32)
@@ -524,11 +692,15 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     # batched gather for all three 16-byte windows (code/SMC, l1, l2).
     # On TPU the step's cost is the count of unfusable gather kernels,
     # so the walks and window reads are batched, not sequential.
-    gva6 = jnp.stack([
-        l1_addr, l1_addr + (l1_size - 1).astype(jnp.uint64),
-        l2_addr, l2_addr + (l2_size - 1).astype(jnp.uint64),
-        st_addr, st_addr + (st_size - 1).astype(jnp.uint64)])
-    t6 = translate_vec(image, overlay, st.cr3, gva6)
+    def _span_last(addr_l, size):
+        return L.add64_u32(addr_l, (size - 1).astype(jnp.uint32))
+
+    gva6_l = jnp.stack([
+        jnp.stack(p, axis=-1) for p in (
+            l1_addr_l, _span_last(l1_addr_l, l1_size),
+            l2_addr_l, _span_last(l2_addr_l, l2_size),
+            st_addr_l, _span_last(st_addr_l, st_size))])
+    t6 = translate_vec_l(image, overlay, st.cr3, gva6_l)
 
     def _tr(i):
         return Translation(gpa=t6.gpa[i], ok=t6.ok[i],
@@ -555,41 +727,33 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     live = pre_live & ~smc
     is_crash = live & (is_(U.OPC_INT) | is_(U.OPC_HLT) | is_(U.OPC_INT1))
 
-    # -- 4c. operand values ----------------------------------------------
-    src_raw = jnp.where(sk == U.K_REG, _read_reg(gpr, sr, srcsize),
-               jnp.where(sk == U.K_MEM, l1_lo & _size_mask(srcsize), _u(0)))
-    src_ext = jnp.where(sext_f == 1, _sext(src_raw, srcsize) & opmask,
-                        src_raw & opmask)
-    src_val = jnp.where(sk == U.K_IMM, imm & opmask, src_ext)
-    dst_val = jnp.where(dk == U.K_REG, _read_reg(gpr, dr, opsize),
-               jnp.where(dk == U.K_MEM, l2_lo & opmask, _u(0)))
+    # -- 4c. operand values (ported: read/extend/mask in u32 limbs; the
+    # u64 views below are free bitcasts for the cold classes) -------------
+    l1_lo_l = L.pair(l1_lo)
+    l2_lo_l = L.pair(l2_lo)
+    zero_l = (jnp.uint32(0), jnp.uint32(0))
+    src_raw_l = L.where64(
+        sk == U.K_REG, _read_reg_l(glimb, sr, srcsize),
+        L.where64(sk == U.K_MEM, L.zext(l1_lo_l, srcsize), zero_l))
+    src_ext_l = L.where64(
+        sext_f == 1, L.zext(L.sext(src_raw_l, srcsize), opsize),
+        L.zext(src_raw_l, opsize))
+    src_val_l = L.where64(sk == U.K_IMM, L.zext(imm_l, opsize), src_ext_l)
+    dst_val_l = L.where64(
+        dk == U.K_REG, _read_reg_l(glimb, dr, opsize),
+        L.where64(dk == U.K_MEM, L.zext(l2_lo_l, opsize), zero_l))
+    src_val = L.to_u64(src_val_l)
+    dst_val = L.to_u64(dst_val_l)
 
-    # -- 4d. integer ALU classes (mirrors cpu/emu.py exactly) -------------
+    # -- 4d. integer ALU classes (ported; mirrors cpu/emu.py exactly) -----
     a, b = dst_val, src_val
-    cf_in = (rf & _u(_CF)) != _u(0)
+    cf_in = (rf_lo & jnp.uint32(_CF)) != jnp.uint32(0)
     cf_in_u = jnp.where(cf_in, _u(1), _u(0))
 
-    # ALU ------------------------------------------------------------
-    r_add = (a + b) & opmask
-    r_adc = (a + b + cf_in_u) & opmask
-    r_sub = (a - b) & opmask
-    r_sbb = (a - b - cf_in_u) & opmask
-    r_and, r_or, r_xor = a & b, a | b, a ^ b
-    alu_r = jnp.select(
-        [sub == U.ALU_ADD, sub == U.ALU_ADC, sub == U.ALU_SUB,
-         sub == U.ALU_SBB, sub == U.ALU_CMP, sub == U.ALU_AND,
-         sub == U.ALU_OR, sub == U.ALU_XOR, sub == U.ALU_TEST],
-        [r_add, r_adc, r_sub, r_sbb, r_sub, r_and, r_or, r_xor, r_and],
-        default=_u(0))
-    alu_flags_add = _flags_add(a, b, alu_r, opsize, (sub == U.ALU_ADC) & cf_in)
-    alu_flags_sub = _flags_sub(a, b, alu_r, opsize, (sub == U.ALU_SBB) & cf_in)
-    alu_flags_logic = _flags_logic(alu_r, opsize)
-    alu_is_add = (sub == U.ALU_ADD) | (sub == U.ALU_ADC)
-    alu_is_sub = (sub == U.ALU_SUB) | (sub == U.ALU_SBB) | (sub == U.ALU_CMP)
-    alu_fl = jnp.where(alu_is_add, alu_flags_add,
-                       jnp.where(alu_is_sub, alu_flags_sub, alu_flags_logic))
-    alu_rf = (rf & ~_u(FLAGS_ARITH)) | alu_fl
-    alu_writes = ~((sub == U.ALU_CMP) | (sub == U.ALU_TEST))
+    # ALU (u32 limb path; the u64 image is a bitcast for mem-dst stores)
+    alu_r_l, alu_rf_lo, alu_writes = alu_limb(
+        sub, dst_val_l, src_val_l, cf_in, opsize, rf_lo)
+    alu_r = L.to_u64(alu_r_l)
 
     # SHIFT ----------------------------------------------------------
     is_shxd = (sub == U.SH_SHLD) | (sub == U.SH_SHRD)
@@ -689,25 +853,9 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     sh_rf = jnp.where(cnz, (rf & ~sh_mask) | (sh_full & sh_mask), rf)
     sh_writes = cnz
 
-    # UNARY ----------------------------------------------------------
-    un_inc_r = (a + _u(1)) & opmask
-    un_dec_r = (a - _u(1)) & opmask
-    un_neg_r = (_u(0) - a) & opmask
-    un_not_r = (~a) & opmask
-    un_r = jnp.select(
-        [sub == U.UN_INC, sub == U.UN_DEC, sub == U.UN_NOT, sub == U.UN_NEG],
-        [un_inc_r, un_dec_r, un_not_r, un_neg_r], default=_u(0))
-    un_fl = jnp.where(
-        sub == U.UN_INC, _flags_add(a, _u(1), un_inc_r, opsize, jnp.bool_(False)),
-        jnp.where(sub == U.UN_DEC,
-                  _flags_sub(a, _u(1), un_dec_r, opsize, jnp.bool_(False)),
-                  _flags_sub(_u(0), a, un_neg_r, opsize, jnp.bool_(False))))
-    # inc/dec preserve CF; neg: CF = (a != 0)
-    un_cf = jnp.where((sub == U.UN_INC) | (sub == U.UN_DEC), cf_in,
-                      (a & opmask) != _u(0))
-    un_rf = jnp.where(sub == U.UN_NOT, rf,
-                      (rf & ~_u(FLAGS_ARITH)) | (un_fl & ~_u(_CF))
-                      | jnp.where(un_cf, _u(_CF), _u(0)))
+    # UNARY (ported u32 limb path) ------------------------------------
+    un_r_l, un_rf_lo = unary_limb(sub, dst_val_l, cf_in, opsize, rf_lo)
+    un_r = L.to_u64(un_r_l)
 
     # MUL ------------------------------------------------------------
     sa_s, sb_s = _sext(a, opsize), _sext(b, opsize)
@@ -955,9 +1103,12 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
                          jnp.bool_(True))
     str_upd = live & is_string & ~unsupported & ~rep_skip
 
-    # control flow -----------------------------------------------------
-    cc_true = _eval_cond(rf, rcx, cond)
-    jmp_target = jnp.where(sk == U.K_IMM, next_rip + imm, src_val)
+    # control flow (ported: condition eval + relative targets in limbs;
+    # indirect targets come from registers/memory through the u64 seam) --
+    rcx_l = (glimb[1, 0], glimb[1, 1])
+    cc_true = L.eval_cond(rf_lo, rcx_l, cond)
+    jcc_target_l = L.add64(next_rip_l, imm_l)
+    jmp_target = jnp.where(sk == U.K_IMM, L.to_u64(jcc_target_l), src_val)
     ret_target = l1_lo
     syscall_entry = sub == 0
 
@@ -1610,7 +1761,10 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     i0, i1_, i2_, i4_, i5_, i11_ = (jnp.int32(0), jnp.int32(1), jnp.int32(2),
                                     jnp.int32(4), jnp.int32(5), jnp.int32(11))
 
-    # primary register write (the generic `store_dst` reg case of emu.py)
+    # primary register write (the generic `store_dst` reg case of emu.py).
+    # Ported-class values (MOV/LEA/ALU/UNARY/SETCC/CMOVCC) were computed
+    # on u32 limbs above and enter this chain as free bitcasts — one
+    # shared register-file scatter for hot and cold classes alike.
     w1_cond = opc_list([
         (is_(U.OPC_MOV), dk == U.K_REG),
         (is_(U.OPC_LEA), jnp.bool_(True)),
@@ -1823,11 +1977,15 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         new_gpr = new_gpr.at[ridx].set(
             jnp.where(cpw, cpuid_out[col], new_gpr[ridx]))
 
+    # all writes (hot values entered the chains as bitcasts) land through
+    # the one shared u64 scatter; the limb file is a free bitcast back
+    glimb_out = L.unpack_u64(new_gpr)
+
     # -- rflags ------------------------------------------------------------
+    # Ported classes (ALU/UNARY) produce a u32 low-limb image; everything
+    # else rides the u64 chain and splits at the seam below.
     rf_exec = opc_list([
-        (is_(U.OPC_ALU), alu_rf),
         (is_(U.OPC_SHIFT), sh_rf),
-        (is_(U.OPC_UNARY), un_rf),
         (is_mul, mul_rf),
         (is_(U.OPC_BT), bt_rf),
         (is_(U.OPC_BITSCAN), bs_rf),
@@ -1843,12 +2001,20 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_x87 & (sub == U.X87_COMI), x87_comi_rf),
         (is_(U.OPC_PEXT), bmi_rf),
     ], rf)
-    new_rf = jnp.where(commit, rf_exec | _u(0x2), rf)
+    hot_rf = is_(U.OPC_ALU) | is_(U.OPC_UNARY)
+    rf_cold_lo, rf_cold_hi = L.pair(rf_exec)
+    rf_exec_lo = jnp.where(
+        hot_rf, jnp.where(is_(U.OPC_ALU), alu_rf_lo, un_rf_lo), rf_cold_lo)
+    new_rf_lo = jnp.where(commit, rf_exec_lo | jnp.uint32(0x2), rf_lo)
+    # hot classes never touch bits 32+ (arith flags live in the low limb)
+    new_rf_hi = jnp.where(commit & ~hot_rf, rf_cold_hi, rf_hi)
 
     # -- rip ---------------------------------------------------------------
+    # ported: fallthrough and Jcc targets come from the limb adder
+    jcc_rip_l = L.where64(cc_true, jcc_target_l, next_rip_l)
     rip_exec = opc_list([
         (is_(U.OPC_JMP) | is_call, jmp_target),
-        (is_(U.OPC_JCC), jnp.where(cc_true, next_rip + imm, next_rip)),
+        (is_(U.OPC_JCC), L.to_u64(jcc_rip_l)),
         (is_ret, ret_target),
         (is_(U.OPC_SYSCALL), jnp.where(syscall_entry, st.lstar, gpr[1])),
         (is_string, jnp.where(str_done, next_rip, rip)),
@@ -1998,10 +2164,15 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         jnp.where(enabled & page_fault, jnp.int32(0), st.fault_write))
 
     return st._replace(
-        gpr=new_gpr, rip=new_rip, rflags=new_rf, xmm=new_xmm,
+        gpr_l=glimb_out,
+        rip_l=L.unpack_u64(new_rip),
+        rflags_l=jnp.stack([new_rf_lo, new_rf_hi]),
+        xmm_l=L.unpack_u64(new_xmm).reshape(16, 8),
         fpst=new_fpst, fpcw=new_fpcw, fpsw=new_fpsw, fptw=new_fptw,
         mxcsr=new_mxcsr,
-        fs_base=new_fs, gs_base=new_gs, kernel_gs_base=new_kgs,
+        fs_base_l=L.unpack_u64(new_fs),
+        gs_base_l=L.unpack_u64(new_gs),
+        kernel_gs_base=new_kgs,
         lstar=new_lstar, star=new_star, sfmask=new_sfmask,
         efer=new_efer, tsc=new_tsc,
         cr0=new_cr0, cr3=new_cr3, cr4=new_cr4, cr8=new_cr8,
@@ -2019,7 +2190,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 _CHUNK_CACHE: dict = {}
 
 
-def make_run_chunk(n_steps: int, donate: bool = True):
+def make_run_chunk(n_steps: int, donate: bool = None):
     """Build (or fetch) the jitted chunk executor: up to n_steps vmapped
     transitions with early exit when no lane is RUNNING.  The host runner
     (interp/runner.py) calls this in a loop, servicing lane statuses between
@@ -2036,7 +2207,17 @@ def make_run_chunk(n_steps: int, donate: bool = True):
     copies template leaves rather than aliasing them, and the runner
     reassigns its machine from the result.  Callers that reuse an argument
     tuple across calls (the driver's entry() compile check) need
-    donate=False."""
+    donate=False.
+
+    CAVEAT — CPU backend: donation is demonstrably unsound there with this
+    graph (XLA CPU's buffer reuse around donated while_loop carries plus
+    the u32<->u64 bitcast views corrupts live machine leaves — observed as
+    garbage status/fpsw/xmm reads, reproducible and gone with donation
+    off).  The Runner therefore requests donation only off-CPU, where it
+    actually matters (HBM); pass donate explicitly if you know better.
+    donate=None (the default) resolves to that policy lazily."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
     key = (n_steps, donate)
     cached = _CHUNK_CACHE.get(key)
     if cached is not None:

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from wtf_tpu.cpu.uops import INT_FIELDS, Uop
+from wtf_tpu.interp.limbs import unpack_np
 from wtf_tpu.utils.hashing import splitmix64
 
 NF = len(INT_FIELDS)
@@ -66,9 +67,14 @@ class UopTable(NamedTuple):
     Entry metadata is packed into TWO row-gatherable arrays (one int32, one
     uint64) so fetching an instruction costs two gathers instead of nine —
     on TPU the per-step cost is dominated by the count of unfusable gather
-    kernels, not their width."""
+    kernels, not their width.
 
-    rip: jax.Array       # uint64[capacity] (probe verification)
+    The probe-verification rip column is stored as u32 limb pairs so the
+    hash-probe path of the device step (interp/step.py `uop_lookup`) runs
+    entirely in u32 — TPUs have no native u64, and the probe compare is
+    per-step hot (interp/limbs.py has the representation contract)."""
+
+    rip_l: jax.Array     # uint32[capacity, 2] (probe verification, LE limbs)
     meta_i32: jax.Array  # int32[capacity, NF + 3]: Uop fields, pfn0, pfn1, bp
     meta_u64: jax.Array  # uint64[capacity, 4]: disp, imm, raw_lo, raw_hi
     hash_tab: jax.Array  # int32[hash_size]; entry index or -1
@@ -215,7 +221,7 @@ class DecodeCache:
             meta_u64 = np.stack(
                 [self.disp, self.imm, self.raw_lo, self.raw_hi], axis=1)
             self._device = UopTable(
-                rip=jnp.asarray(self.rip),
+                rip_l=jnp.asarray(unpack_np(self.rip)),
                 meta_i32=jnp.asarray(meta_i32),
                 meta_u64=jnp.asarray(meta_u64),
                 hash_tab=jnp.asarray(self.hash_tab),

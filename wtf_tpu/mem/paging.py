@@ -67,6 +67,21 @@ def translate(
                        writable=t.writable[0], user=t.user[0])
 
 
+def translate_vec_l(
+    image: MemImage, overlay: DirtyOverlay, cr3: jax.Array, gva_l: jax.Array
+) -> Translation:
+    """`translate_vec` over u32 limb-packed GVAs (uint32[K, 2], limb 0 low).
+
+    This is the pack_u64 boundary adapter for the limb-packed interpreter
+    hot path (interp/limbs.py): addresses are computed in u32 limbs, and
+    the page walk — gather-bound, not elementwise-bound — converts at this
+    seam with a free bitcast and runs in u64 as before.
+    """
+    from wtf_tpu.interp.limbs import pack_u64
+
+    return translate_vec(image, overlay, cr3, pack_u64(gva_l))
+
+
 def translate_vec(
     image: MemImage, overlay: DirtyOverlay, cr3: jax.Array, gva_vec: jax.Array
 ) -> Translation:
