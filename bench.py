@@ -487,6 +487,83 @@ def micro_compare(baseline_path: str | None) -> None:
     }))
 
 
+def telemetry_mode(telemetry_dir: str | None = None) -> None:
+    """`bench.py --telemetry [dir]`: a short instrumented campaign whose
+    JSON is DERIVED FROM THE METRICS REGISTRY — the same counters and
+    span totals behind the campaign heartbeat — rather than hand-rolled
+    timers, so bench numbers and campaign telemetry can never disagree
+    about definitions.  With a dir argument the JSONL event stream lands
+    there too (summarize with tools/telemetry_report.py).
+
+    Runs on the CPU platform unless BENCH_PLATFORM=native (same policy as
+    --micro-compare: this mode is about the telemetry plumbing, not chip
+    throughput)."""
+    if os.environ.get("BENCH_PLATFORM", "cpu") != "native":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    import random
+
+    from wtf_tpu.backend import create_backend
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+    from wtf_tpu.fuzz.native_mutator import best_mangle_mutator
+    from wtf_tpu.harness import demo_tlv
+    from wtf_tpu.telemetry import Registry, open_event_log
+
+    registry = Registry()
+    events = open_event_log(telemetry_dir)
+    events.emit("run-start", subcommand="bench--telemetry")
+    try:
+        seconds = float(os.environ.get("BENCH_SECONDS", "10"))
+        n_lanes = int(os.environ.get("BENCH_TELEM_LANES", "64"))
+        chunk_steps = int(os.environ.get("BENCH_TELEM_CHUNK", "512"))
+        backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                                 n_lanes=n_lanes, limit=100_000,
+                                 chunk_steps=chunk_steps,
+                                 overlay_slots=32, registry=registry,
+                                 events=events)
+        backend.initialize()
+        demo_tlv.TARGET.init(backend)
+        rng = random.Random(0x77F)
+        corpus = Corpus(rng=rng)
+        corpus.add(b"\x01\x04AAAA\x02\x08BBBBBBBB")
+        loop = FuzzLoop(backend, demo_tlv.TARGET,
+                        best_mangle_mutator(rng, max_len=0x400), corpus,
+                        registry=registry, events=events, stats_every=2.0)
+        loop.run_one_batch()  # warmup: XLA compile + decode servicing
+        start = time.time()
+        start_count = loop.stats.testcases
+        while time.time() - start < seconds:
+            loop.run_one_batch()
+            loop._heartbeat(print_stats=False)
+        elapsed = time.time() - start
+        metrics = registry.dump()
+        phase_seconds = metrics.get("phase.seconds", {})
+        top_phases = {name: round(secs, 3)
+                      for name, secs in sorted(phase_seconds.items())
+                      if "/" not in name}
+        report = {
+            "metric": "telemetry campaign (demo_tlv, registry-derived)",
+            "value": round(
+                (loop.stats.testcases - start_count) / elapsed, 1),
+            "unit": "execs/s",
+            "elapsed_s": round(elapsed, 3),
+            "phases": top_phases,
+            "metrics": metrics,
+        }
+    finally:
+        # run-end even on a failed build: the JSONL must never be
+        # indistinguishable from a killed run (same invariant as cli.py)
+        events.emit("run-end", metrics=registry.dump())
+        events.close()
+    print(json.dumps(report))
+
+
 def main() -> None:
     # total budget divided across attempts so a hanging TPU init can never
     # push the final (cpu) attempt past the driver's outer timeout.  A
@@ -543,5 +620,8 @@ if __name__ == "__main__":
     elif "--micro-compare" in sys.argv:
         _args = [a for a in sys.argv[1:] if not a.startswith("--")]
         micro_compare(_args[0] if _args else None)
+    elif "--telemetry" in sys.argv:
+        _args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        telemetry_mode(_args[0] if _args else None)
     else:
         main()

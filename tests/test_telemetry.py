@@ -1,0 +1,541 @@
+"""Unified telemetry subsystem tests (wtf_tpu/telemetry/ + the device
+counter block): registry counter/label semantics, span fencing, JSONL
+schema round-trip, device-counter vs oracle differentials, campaign
+wall-clock accounting, and the report tool."""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from wtf_tpu.backend import create_backend
+from wtf_tpu.backend.emu import EmuBackend
+from wtf_tpu.core.results import Crash, Ok
+from wtf_tpu.dist.client import run_testcase_and_restore
+from wtf_tpu.harness import demo_tlv
+from wtf_tpu.interp.machine import (
+    CTR_DECODE_MISS, CTR_INSTR, CTR_MEM_FAULT, N_CTRS,
+)
+from wtf_tpu.telemetry import (
+    EventLog, NULL, Registry, StatsDict, get_registry, open_event_log,
+    read_events,
+)
+
+from test_harness import BENIGN, OVERFLOW
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    reg = Registry()
+    c = reg.counter("x.count")
+    c.inc()
+    c.inc(5)
+    assert c.value == 6
+    assert reg.counter("x.count") is c  # idempotent registration
+    g = reg.gauge("x.depth")
+    g.set(3)
+    g.set(2)
+    assert g.value == 2
+    h = reg.histogram("x.lat")
+    for v in (0.5, 1.5, 1.0):
+        h.observe(v)
+    d = h.dump()
+    assert d == {"count": 3, "sum": 3.0, "min": 0.5, "max": 1.5}
+    with pytest.raises(TypeError):
+        reg.gauge("x.count")  # type mismatch on re-registration
+
+
+def test_labeled_children_semantics():
+    reg = Registry()
+    c = reg.counter("fallbacks")
+    c.labels("ssefp").inc(3)
+    c.labels("x87").inc()
+    assert c.labels("ssefp").value == 3
+    assert reg.dump()["fallbacks"] == {"ssefp": 3, "x87": 1}
+
+
+def test_registry_dump_is_json_able():
+    reg = Registry()
+    reg.counter("a").inc()
+    reg.counter("b").labels("k").inc()
+    reg.histogram("h").observe(1)
+    reg.gauge("g").set(7)
+    parsed = json.loads(json.dumps(reg.dump()))
+    assert parsed["a"] == 1 and parsed["g"] == 7
+    assert parsed["b"] == {"k": 1}
+
+
+def test_stats_dict_facade_preserves_dict_api():
+    reg = Registry()
+    stats = StatsDict(reg, "runner", fields=("chunks", "fallbacks"),
+                      gauges=("max_chunk_steps",),
+                      labeled=("fallbacks_by_opclass",))
+    stats["chunks"] += 1
+    stats["chunks"] += 1
+    stats["max_chunk_steps"] = max(stats["max_chunk_steps"], 512)
+    by_class = stats["fallbacks_by_opclass"]
+    by_class["ssefp"] = by_class.get("ssefp", 0) + 1
+    assert stats["chunks"] == 2
+    assert stats["max_chunk_steps"] == 512
+    assert dict(stats["fallbacks_by_opclass"]) == {"ssefp": 1}
+    assert set(stats) >= {"chunks", "fallbacks", "max_chunk_steps"}
+    # the same numbers are visible registry-side (the whole point)
+    dump = reg.dump()
+    assert dump["runner.chunks"] == 2
+    assert dump["runner.fallbacks_by_opclass"] == {"ssefp": 1}
+    # a declared-labeled key with no children dumps as {} (not 0)
+    stats2 = StatsDict(Registry(), "r", labeled=("by_x",))
+    assert stats2._registry.dump()["r.by_x"] == {}
+
+
+def test_registry_isolation_between_instances():
+    a, b = Registry(), Registry()
+    a.counter("n").inc()
+    assert b.counter("n").value == 0
+    assert get_registry() is get_registry()  # global singleton exists
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_records_monotonic_and_nested_paths():
+    reg = Registry()
+    clock = [0.0]
+
+    def fake_clock():
+        return clock[0]
+
+    from wtf_tpu.telemetry.spans import Spans
+
+    spans = Spans(reg, clock=fake_clock)
+    with spans.span("execute"):
+        clock[0] += 1.0
+        with spans.span("device-step"):
+            clock[0] += 2.0
+        clock[0] += 0.5
+    with spans.span("restore"):
+        clock[0] += 0.25
+    secs = reg.counter("phase.seconds").children
+    assert secs["execute"].value == pytest.approx(3.5)
+    assert secs["execute/device-step"].value == pytest.approx(2.0)
+    assert secs["restore"].value == pytest.approx(0.25)
+    calls = reg.counter("phase.calls").children
+    assert calls["execute"].value == 1
+    assert spans.seconds("execute") == pytest.approx(3.5)
+    # re-entry accumulates and stays monotonic
+    with spans.span("execute"):
+        clock[0] += 1.0
+    assert secs["execute"].value == pytest.approx(4.5)
+
+
+def test_span_records_on_exception_and_rebalances_stack():
+    reg = Registry()
+    spans = reg.spans
+    with pytest.raises(ValueError):
+        with spans.span("boom"):
+            raise ValueError("x")
+    assert reg.counter("phase.calls").children["boom"].value == 1
+    # the stack recovered: a new span is top-level, not nested under boom
+    with spans.span("after"):
+        pass
+    assert "after" in reg.counter("phase.seconds").children
+
+
+def test_span_fence_blocks_device_values():
+    import jax.numpy as jnp
+
+    reg = Registry()
+    with reg.spans.span("device") as sp:
+        value = jnp.arange(8).sum()
+        sp.fence(value)  # must not raise; host values fine too
+        sp.fence(None)
+        sp.fence({"nested": [value]})
+    assert reg.spans.seconds("device") >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# JSONL event log
+# ---------------------------------------------------------------------------
+
+def test_event_log_schema_round_trip(tmp_path):
+    reg = Registry()
+    reg.counter("campaign.testcases").inc(7)
+    path = tmp_path / "telem"
+    with open_event_log(path) as log:
+        log.emit("run-start", subcommand="test", argv=["--x"])
+        log.heartbeat(reg, line="#7 exec/s: 1.0", nodes=2)
+        log.emit("crash", name="crash-read-0xdead", size=9)
+        log.emit("run-end", metrics=reg.dump())
+    records = list(read_events(path / "events.jsonl"))
+    assert [r["type"] for r in records] == [
+        "run-start", "heartbeat", "crash", "run-end"]
+    # schema: every record has ts + monotonically increasing seq
+    assert all("ts" in r for r in records)
+    assert [r["seq"] for r in records] == [0, 1, 2, 3]
+    hb = records[1]
+    assert hb["line"] == "#7 exec/s: 1.0" and hb["nodes"] == 2
+    assert hb["metrics"]["campaign.testcases"] == 7
+    assert records[3]["metrics"]["campaign.testcases"] == 7
+    # append mode: a second log continues the file
+    with EventLog(path / "events.jsonl") as log:
+        log.emit("run-start")
+    assert len(list(read_events(path / "events.jsonl"))) == 5
+
+
+def test_event_log_skips_torn_tail(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with EventLog(path) as log:
+        log.emit("run-start")
+    with open(path, "a") as fh:
+        fh.write('{"ts": 1.0, "seq": 1, "type": "hea')  # killed mid-write
+    records = list(read_events(path))
+    assert len(records) == 1
+
+
+def test_null_event_log_swallows_everything(tmp_path):
+    assert open_event_log(None) is NULL
+    NULL.emit("crash", name="x")
+    NULL.heartbeat(Registry(), line="y")
+    NULL.flush()
+    NULL.close()
+
+
+def test_event_log_degrades_to_noop_on_write_failure(tmp_path):
+    """Telemetry is a side-channel: a full disk must not abort the
+    campaign it narrates — emit degrades to a no-op after one OSError."""
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path)
+    log.emit("run-start")
+
+    class _BrokenFH:
+        closed = False
+
+        def write(self, s):
+            raise OSError(28, "No space left on device")
+
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+
+    log._fh = _BrokenFH()
+    log.emit("heartbeat")  # must not raise
+    assert log._broken
+    log.emit("crash", name="x")  # silent no-op now
+    log.flush()
+    log.close()
+    assert [r["type"] for r in read_events(path)] == ["run-start"]
+
+
+def test_maybe_heartbeat_skips_line_fn_when_unobserved():
+    """line_fn can cost a device coverage readback — it must not run when
+    neither a human (print_stats) nor a real event sink consumes it."""
+    from wtf_tpu.fuzz.loop import CampaignStats
+
+    stats = CampaignStats(Registry())
+    calls = []
+
+    def line_fn():
+        calls.append(1)
+        return "#0 line"
+
+    assert stats.maybe_heartbeat(NULL, None, line_fn, every=0.0) is None
+    assert not calls
+    assert stats.maybe_heartbeat(NULL, None, line_fn, every=0.0,
+                                 print_stats=True) == "#0 line"
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# device-side counters
+# ---------------------------------------------------------------------------
+
+def _tpu_backend(n_lanes=2):
+    backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                             n_lanes=n_lanes, limit=100_000,
+                             chunk_steps=128)
+    backend.initialize()
+    demo_tlv.TARGET.init(backend)
+    return backend
+
+
+@pytest.fixture(scope="module")
+def tpu_backend():
+    return _tpu_backend()
+
+
+def test_device_instr_counter_matches_oracle_differential(tpu_backend):
+    """The instructions-retired counter must equal the oracle
+    interpreter's icount for the same testcase on both backends — the
+    anchor that makes every derived rate trustworthy."""
+    emu = EmuBackend(demo_tlv.build_snapshot(), limit=100_000)
+    emu.initialize()
+    demo_tlv.TARGET.init(emu)
+    result, _ = run_testcase_and_restore(emu, demo_tlv.TARGET, BENIGN)
+    assert isinstance(result, Ok)
+    oracle_instr = emu.stats["instructions"]
+    assert oracle_instr > 0
+
+    backend = tpu_backend
+    backend.restore()
+    results = backend.run_batch([BENIGN, BENIGN], demo_tlv.TARGET)
+    assert all(isinstance(r, Ok) for r in results)
+    ctr = backend.runner.device_counters()
+    assert ctr.shape == (2, N_CTRS)
+    icount = np.asarray(backend.runner.machine.icount)
+    for lane in range(2):
+        assert int(ctr[lane, CTR_INSTR]) == int(icount[lane]) == oracle_instr
+    # folded host metrics carry the batch totals
+    assert (backend.registry.counter("device.instructions").value
+            >= 2 * oracle_instr)
+    backend.restore()
+
+
+def test_compile_event_fires_for_base_chunk_size(tmp_path):
+    """The coldest XLA compile of a campaign (the base chunk size's first
+    dispatch) must be reported — make_run_chunk pre-builds the callable
+    at init, but jit compiles on the first CALL.  Uses an executor shape
+    (chunk size) no other test dispatches: compile tracking is
+    process-global like the jit cache, so a warm shape rightly stays
+    silent."""
+    with EventLog(tmp_path / "events.jsonl") as events:
+        backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                                 n_lanes=2, limit=100_000,
+                                 chunk_steps=96, events=events)
+        backend.initialize()
+        demo_tlv.TARGET.init(backend)
+        backend.run_batch([BENIGN], demo_tlv.TARGET)
+        backend.restore()
+        backend.run_batch([BENIGN], demo_tlv.TARGET)
+    compiles = [r for r in read_events(tmp_path / "events.jsonl")
+                if r["type"] == "compile"]
+    # exactly one event for the base size: fired on the FIRST dispatch
+    # (cold compile), silent on the warm second batch
+    assert len([r for r in compiles if r["chunk_steps"] == 96]) == 1, compiles
+
+
+def test_device_decode_miss_counter_and_restore_reset():
+    backend = _tpu_backend()  # fresh: cold decode cache
+    backend.run_batch([BENIGN], demo_tlv.TARGET)
+    ctr = backend.runner.device_counters()
+    assert int(ctr[0, CTR_DECODE_MISS]) > 0  # cold cache missed at least once
+    assert backend.registry.counter("device.decode_misses").value > 0
+    backend.restore()
+    assert int(backend.runner.device_counters().sum()) == 0  # reset wipes
+    # warm cache: a re-run misses nothing
+    backend.run_batch([BENIGN], demo_tlv.TARGET)
+    assert int(backend.runner.device_counters()[0, CTR_DECODE_MISS]) == 0
+
+
+def test_device_mem_fault_counter_on_memory_crash(tpu_backend):
+    backend = tpu_backend
+    backend.restore()
+    results = backend.run_batch([OVERFLOW], demo_tlv.TARGET)
+    assert isinstance(results[0], Crash)
+    ctr = backend.runner.device_counters()
+    if any(kind in (results[0].name or "")
+           for kind in ("read", "write", "execute")):
+        assert int(ctr[0, CTR_MEM_FAULT]) >= 1
+    assert backend.registry.counter("device.mem_faults").value >= int(
+        ctr[0, CTR_MEM_FAULT])
+    backend.restore()
+
+
+# ---------------------------------------------------------------------------
+# campaign integration: spans account for wall-clock, events flow
+# ---------------------------------------------------------------------------
+
+def test_campaign_telemetry_accounts_wall_clock(tmp_path):
+    """Acceptance criterion: a fuzz run with --telemetry-dir produces a
+    JSONL whose top-level per-phase span totals account for >= 90% of the
+    run's wall-clock (run-start -> run-end)."""
+    from wtf_tpu.cli import main
+
+    telem = tmp_path / "telem"
+    rc = main(["campaign", "--name", "demo_tlv", "--backend", "emu",
+               "--runs", "200", "--seed", "7", "--max_len", "64",
+               "--crashes", str(tmp_path / "crashes"),
+               "--telemetry-dir", str(telem)])
+    assert rc in (0, 2)
+    records = list(read_events(telem / "events.jsonl"))
+    assert records[0]["type"] == "run-start"
+    end = [r for r in records if r["type"] == "run-end"]
+    assert end, [r["type"] for r in records]
+    metrics = end[-1]["metrics"]
+    wall = end[-1]["ts"] - records[0]["ts"]
+    top = {name: secs
+           for name, secs in metrics["phase.seconds"].items()
+           if "/" not in name}
+    assert wall > 0
+    assert sum(top.values()) >= 0.9 * wall, (top, wall)
+    # phases tile the batch loop
+    assert {"mutate", "execute", "harvest", "restore"} <= set(top)
+    assert metrics["campaign.testcases"] >= 200
+
+
+def test_campaign_crash_and_heartbeat_events(tmp_path):
+    from wtf_tpu.cli import main
+
+    telem = tmp_path / "telem"
+    rc = main(["campaign", "--name", "demo_tlv", "--backend", "emu",
+               "--runs", "600", "--seed", "5", "--max_len", "128",
+               "--crashes", str(tmp_path / "crashes"),
+               "--stop-on-crash", "--telemetry-dir", str(telem)])
+    assert rc == 2
+    types = [r["type"] for r in read_events(telem / "events.jsonl")]
+    assert "crash" in types
+    assert "heartbeat" in types  # last_print starts at 0 -> first batch
+    assert types[-1] == "run-end"
+
+
+def test_run_end_written_when_setup_fails(tmp_path):
+    """A failed backend build must still close the JSONL with a run-end
+    record — a telemetry file that just stops is indistinguishable from a
+    killed run."""
+    from wtf_tpu.cli import main
+
+    telem = tmp_path / "telem"
+    (tmp_path / "state").mkdir()  # exists but holds no snapshot
+    with pytest.raises((Exception, SystemExit)):
+        main(["campaign", "--name", "demo_tlv", "--backend", "emu",
+              "--runs", "1", "--state", str(tmp_path / "state"),
+              "--telemetry-dir", str(telem)])
+    records = list(read_events(telem / "events.jsonl"))
+    assert records[0]["type"] == "run-start"
+    assert records[-1]["type"] == "run-end"
+
+
+def test_fuzz_loop_stats_attribute_api_still_works():
+    """CampaignStats keeps the reference-shaped attribute API while the
+    values live in the registry."""
+    from wtf_tpu.fuzz.loop import CampaignStats
+
+    reg = Registry()
+    stats = CampaignStats(reg)
+    stats.testcases += 3
+    stats.crashes += 1
+    assert stats.testcases == 3
+    assert reg.dump()["campaign.testcases"] == 3
+    line = stats.line(5, 17)
+    assert line.startswith("#3 cov: 17 corp: 5 exec/s: ")
+    assert "crash: 1" in line
+    # the node-shaped line omits cov/corp but keeps the rest
+    assert stats.line().startswith("#3 exec/s: ")
+
+
+# ---------------------------------------------------------------------------
+# trace writers: context-manager + flush (satellite)
+# ---------------------------------------------------------------------------
+
+def test_trace_writers_context_manager_and_flush(tmp_path):
+    from wtf_tpu.trace import (
+        CovTraceWriter, RipTraceWriter, TenetTraceWriter,
+    )
+
+    rip_path = tmp_path / "rip.txt"
+    with RipTraceWriter(rip_path) as w:
+        w.on_step(0x1000)
+        w.flush()  # buffered lines reach disk BEFORE close
+        assert rip_path.read_text() == "0x1000\n"
+        w.on_step(0x1001)
+    assert rip_path.read_text() == "0x1000\n0x1001\n"
+    w.close()  # double-close is safe
+    with CovTraceWriter(tmp_path / "cov.txt") as w:
+        w.on_step(0x2000)
+        w.on_step(0x2000)
+    assert (tmp_path / "cov.txt").read_text() == "0x2000\n"
+    regs = {name: 0 for name in
+            ("rax", "rbx", "rcx", "rdx", "rbp", "rsp", "rsi", "rdi", "r8",
+             "r9", "r10", "r11", "r12", "r13", "r14", "r15", "rip")}
+    try:
+        with TenetTraceWriter(tmp_path / "tenet.txt") as w:
+            w.on_step(regs)
+            raise RuntimeError("crash mid-trace")
+    except RuntimeError:
+        pass
+    # the crashed run's buffered lines were not lost
+    assert "rax=0x0" in (tmp_path / "tenet.txt").read_text()
+
+
+# ---------------------------------------------------------------------------
+# report tool smoke test
+# ---------------------------------------------------------------------------
+
+def test_telemetry_report_on_bench_output(tmp_path, capsys, monkeypatch):
+    """The CI/tooling satellite end-to-end: bench.py --telemetry writes a
+    registry-derived JSON + an events.jsonl, and telemetry_report
+    summarizes that bench output (per-phase share, testcases/s)."""
+    import bench
+    import telemetry_report
+
+    monkeypatch.setenv("BENCH_SECONDS", "1")
+    monkeypatch.setenv("BENCH_TELEM_LANES", "2")
+    monkeypatch.setenv("BENCH_TELEM_CHUNK", "128")
+    telem = tmp_path / "telem"
+    bench.telemetry_mode(str(telem))
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the bench JSON is DERIVED from the registry dump
+    assert report["metrics"]["campaign.testcases"] >= 2
+    assert "execute" in report["phases"]
+    summary = telemetry_report.summarize(telem)
+    assert summary["testcases"] == report["metrics"]["campaign.testcases"]
+    assert summary["phases"]  # per-phase time share present
+    assert summary["device"]["instructions"] > 0
+
+
+def test_telemetry_report_segments_appended_runs(tmp_path):
+    """EventLog appends, so one events.jsonl can hold several runs; the
+    report must summarize the LATEST run, not stretch wall-clock across
+    the gap between runs (which would crater every rate and share)."""
+    import telemetry_report
+
+    path = tmp_path / "events.jsonl"
+    reg = Registry()
+    reg.counter("campaign.testcases").inc(100)
+    reg.counter("phase.seconds").labels("execute").inc(9.0)
+    clock = iter([0.0, 1.0,            # run 1: start, end
+                  3600.0, 3610.0])     # run 2, an hour later: 10s long
+    with EventLog(path, clock=lambda: next(clock)) as log:
+        log.emit("run-start")
+        log.emit("run-end", metrics={})
+        log.emit("run-start")
+        log.emit("run-end", metrics=reg.dump())
+    summary = telemetry_report.summarize(path)
+    assert summary["runs_in_file"] == 2
+    assert summary["wall_seconds"] == 10.0  # NOT 3610
+    assert summary["testcases_per_s"] == 10.0
+    assert summary["phase_accounted_frac"] == 0.9
+
+
+def test_telemetry_report_summarizes_campaign(tmp_path, capsys):
+    from wtf_tpu.cli import main
+
+    import telemetry_report
+
+    telem = tmp_path / "telem"
+    rc = main(["campaign", "--name", "demo_tlv", "--backend", "emu",
+               "--runs", "150", "--seed", "9", "--max_len", "64",
+               "--telemetry-dir", str(telem)])
+    assert rc in (0, 2)
+    summary = telemetry_report.summarize(telem)
+    assert summary["testcases"] >= 150
+    assert summary["phase_accounted_frac"] >= 0.9
+    assert summary["wall_seconds"] > 0
+    assert "execute" in summary["phases"]
+    assert summary["events_by_type"]["run-start"] == 1
+    # CLI entry: --json emits one parseable object, human mode prints
+    assert telemetry_report.main([str(telem), "--json"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out)["testcases"] == summary["testcases"]
+    assert telemetry_report.main([str(telem)]) == 0
+    assert "phases" in capsys.readouterr().out
+    assert telemetry_report.main([]) == 1

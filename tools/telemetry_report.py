@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Summarize a telemetry events.jsonl (the --telemetry-dir stream).
+
+Answers the operator questions the raw stream buries:
+  - where did the wall-clock go?  per-phase span totals (top-level phases
+    accounted against wall-clock, nested phases shown as a breakdown)
+  - how fast was it?  testcases/s from the campaign counters
+  - why did lanes leave the device?  fallback rate per opclass
+  - what did the device itself count?  instructions retired / memory
+    faults / decode misses from the in-graph counter block
+  - what happened?  event census (crashes, new coverage, errors)
+
+Usage: python tools/telemetry_report.py <events.jsonl | telemetry dir> [--json]
+
+Exit status 1 when the file holds no usable records.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from wtf_tpu.telemetry.events import read_events  # noqa: E402
+
+
+def summarize(path) -> dict:
+    """Machine-readable summary of one events.jsonl."""
+    path = Path(path)
+    if path.is_dir():
+        path = path / "events.jsonl"
+    records = list(read_events(path))
+    if not records:
+        return {"error": f"no records in {path}"}
+
+    # EventLog appends, so re-running with the same --telemetry-dir stacks
+    # runs in one file.  Metrics dumps are per-run (the registry is fresh
+    # each invocation), so summarize the LATEST run: slice at its
+    # run-start, or wall-clock/rates would span the gap between runs.
+    starts = [i for i, r in enumerate(records) if r["type"] == "run-start"]
+    runs_in_file = max(len(starts), 1)
+    if starts:
+        records = records[starts[-1]:]
+
+    first_ts = records[0]["ts"]
+    last_ts = records[-1]["ts"]
+    wall = max(last_ts - first_ts, 0.0)
+
+    # the freshest full metrics dump (run-end normally; the last
+    # heartbeat when the run was killed)
+    metrics = {}
+    for rec in reversed(records):
+        if "metrics" in rec:
+            metrics = rec["metrics"]
+            break
+
+    by_type: dict = {}
+    crashes: dict = {}
+    errors = []
+    for rec in records:
+        by_type[rec["type"]] = by_type.get(rec["type"], 0) + 1
+        if rec["type"] == "crash" and rec.get("name"):
+            crashes[rec["name"]] = crashes.get(rec["name"], 0) + 1
+        elif rec["type"] == "error":
+            errors.append({k: rec.get(k) for k in ("kind", "detail")})
+
+    phase_seconds = metrics.get("phase.seconds", {}) or {}
+    if not isinstance(phase_seconds, dict):
+        phase_seconds = {}
+    top = {name: secs for name, secs in phase_seconds.items()
+           if "/" not in name}
+    top_total = sum(top.values())
+    phases = {
+        name: {"seconds": round(secs, 4),
+               "share_of_wall": round(secs / wall, 4) if wall else None}
+        for name, secs in sorted(top.items(), key=lambda kv: -kv[1])
+    }
+    nested = {name: round(secs, 4)
+              for name, secs in sorted(phase_seconds.items())
+              if "/" in name}
+
+    testcases = metrics.get("campaign.testcases", 0) or 0
+    fallbacks = metrics.get("runner.fallbacks_by_opclass", {})
+    if not isinstance(fallbacks, dict):
+        fallbacks = {}
+    # without a testcase counter (run-subcommand streams) the values are
+    # raw counts, and fallback_rate_unit says so — never pass counts off
+    # as per-testcase rates
+    fallback_rate_unit = "per-testcase" if testcases else "raw-count"
+    fallback_rate = {
+        opclass: round(count / testcases, 4) if testcases else count
+        for opclass, count in sorted(fallbacks.items(), key=lambda kv: -kv[1])
+    }
+
+    return {
+        "path": str(path),
+        "records": len(records),
+        "runs_in_file": runs_in_file,
+        "events_by_type": by_type,
+        "wall_seconds": round(wall, 3),
+        "phases": phases,
+        "phase_accounted_frac": round(top_total / wall, 4) if wall else None,
+        "nested_phases": nested,
+        "testcases": testcases,
+        "testcases_per_s": round(testcases / wall, 2) if wall else None,
+        "crashes": metrics.get("campaign.crashes", 0),
+        "crash_names": crashes,
+        "new_coverage": metrics.get("campaign.new_coverage", 0),
+        "fallbacks": metrics.get("runner.fallbacks", 0),
+        "fallback_rate_unit": fallback_rate_unit,
+        "fallback_rate_per_opclass": fallback_rate,
+        "device": {
+            "instructions": metrics.get("device.instructions", 0),
+            "mem_faults": metrics.get("device.mem_faults", 0),
+            "decode_misses": metrics.get("device.decode_misses", 0),
+        },
+        "errors": errors,
+    }
+
+
+def _print_human(s: dict) -> None:
+    extra = (f" (latest of {s['runs_in_file']} runs in file)"
+             if s["runs_in_file"] > 1 else "")
+    print(f"{s['path']}: {s['records']} records over "
+          f"{s['wall_seconds']}s{extra}")
+    print(f"events: " + ", ".join(
+        f"{t}={n}" for t, n in sorted(s["events_by_type"].items())))
+    if s["phases"]:
+        acct = s["phase_accounted_frac"]
+        print(f"phases (top-level, "
+              f"{acct * 100:.1f}% of wall accounted):" if acct is not None
+              else "phases:")
+        for name, d in s["phases"].items():
+            share = (f" ({d['share_of_wall'] * 100:5.1f}%)"
+                     if d["share_of_wall"] is not None else "")
+            print(f"  {name:<16} {d['seconds']:>10.3f}s{share}")
+        for name, secs in s["nested_phases"].items():
+            print(f"    {name:<24} {secs:>8.3f}s")
+    print(f"testcases: {s['testcases']}"
+          + (f" ({s['testcases_per_s']}/s)" if s["testcases_per_s"] else ""))
+    print(f"crashes: {s['crashes']} new-coverage: {s['new_coverage']}")
+    if s["crash_names"]:
+        for name, n in sorted(s["crash_names"].items()):
+            print(f"  {name} x{n}")
+    if s["fallback_rate_per_opclass"]:
+        label = ("fallback rate per opclass (fallbacks/testcase):"
+                 if s["fallback_rate_unit"] == "per-testcase"
+                 else "fallbacks per opclass (raw counts — no testcase "
+                      "counter in this stream):")
+        print(label)
+        for opclass, rate in s["fallback_rate_per_opclass"].items():
+            print(f"  {opclass:<12} {rate}")
+    dev = s["device"]
+    print(f"device counters: instructions={dev['instructions']} "
+          f"mem_faults={dev['mem_faults']} "
+          f"decode_misses={dev['decode_misses']}")
+    for err in s["errors"]:
+        print(f"error: {err['kind']}: {err['detail']}")
+
+
+def main(argv) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    if not args:
+        print(__doc__.strip(), file=sys.stderr)
+        return 1
+    summary = summarize(args[0])
+    if "error" in summary:
+        print(summary["error"], file=sys.stderr)
+        return 1
+    if "--json" in argv:
+        print(json.dumps(summary))
+    else:
+        _print_human(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main(sys.argv[1:]))
+    except BrokenPipeError:
+        # `... | head` closed the pipe: normal operator usage, not an error
+        sys.exit(0)

@@ -22,15 +22,20 @@ from wtf_tpu.cpu.interrupts import (
     VEC_DE, VEC_PF, DeliveryFailed, deliver_page_fault,
 )
 from wtf_tpu.snapshot.loader import Snapshot
+from wtf_tpu import telemetry
+from wtf_tpu.telemetry import Registry, StatsDict
 from wtf_tpu.utils.hashing import splitmix64
 
 
 class EmuBackend(Backend):
     def __init__(self, snapshot: Snapshot, limit: int = 0,
-                 deliver_exceptions: Optional[bool] = None):
+                 deliver_exceptions: Optional[bool] = None,
+                 registry: Optional[Registry] = None, events=None):
         self.snapshot = snapshot
         self.symbols = snapshot.symbols
         self.limit = limit
+        self.registry, self.events = telemetry.resolve(
+            registry=registry, events=events)
         # Guest exception delivery through the snapshot's IDT (auto: on
         # exactly when the snapshot carries one) — see cpu/interrupts.py.
         if deliver_exceptions is None:
@@ -44,7 +49,8 @@ class EmuBackend(Backend):
         self._last_new: Set[int] = set()
         self._trace_file = None
         self._trace_type = None
-        self.stats = {"runs": 0, "instructions": 0}
+        self.stats = StatsDict(self.registry, "backend",
+                               fields=("runs", "instructions"))
 
     # -- lifecycle ---------------------------------------------------------
     def initialize(self) -> None:

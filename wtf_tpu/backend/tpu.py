@@ -36,6 +36,8 @@ from wtf_tpu.core.results import (
 from wtf_tpu.core.results import StatusCode
 from wtf_tpu.interp.runner import HostView, Runner
 from wtf_tpu.snapshot.loader import Snapshot
+from wtf_tpu import telemetry
+from wtf_tpu.telemetry import Registry, StatsDict
 from wtf_tpu.utils.hashing import splitmix64
 
 MASK64 = (1 << 64) - 1
@@ -78,11 +80,17 @@ def _merge_coverage(agg_cov, agg_edge, cov, edge, include):
 
 class TpuBackend(Backend):
     def __init__(self, snapshot: Snapshot, n_lanes: int = 64,
-                 limit: int = 0, **runner_kwargs):
+                 limit: int = 0, registry: Optional[Registry] = None,
+                 events=None, **runner_kwargs):
         self.snapshot = snapshot
         self.symbols = snapshot.symbols
         self.n_lanes = n_lanes
         self.limit = limit
+        # Telemetry: ONE registry shared with the Runner (and, when the
+        # campaign driver defaults to it, the fuzz loop) so phase spans
+        # nest and the heartbeat dump carries everything
+        self.registry, self.events = telemetry.resolve(
+            registry=registry, events=events)
         self._runner_kwargs = runner_kwargs
         self.runner: Optional[Runner] = None
         self.breakpoints: Dict[int, BreakpointHandler] = {}
@@ -94,12 +102,15 @@ class TpuBackend(Backend):
         self._last_new_words: Optional[np.ndarray] = None
         self._trace_request = None
         # per-campaign counters (reference BochscpuRunStats_t role,
-        # bochscpu_backend.h:17-45)
-        self.stats = {"batches": 0, "testcases": 0, "instructions": 0}
+        # bochscpu_backend.h:17-45) — registry-backed dict facade
+        self.stats = StatsDict(self.registry, "backend",
+                               fields=("batches", "testcases",
+                                       "instructions"))
 
     # -- lifecycle ---------------------------------------------------------
     def initialize(self) -> None:
         self.runner = Runner(self.snapshot, self.n_lanes,
+                             registry=self.registry, events=self.events,
                              **self._runner_kwargs)
         m = self.runner.machine
         self._agg_cov = jnp.zeros_like(m.cov[0])
@@ -144,35 +155,42 @@ class TpuBackend(Backend):
         runner = self.runner
         runner.limit = self.limit
         self._lane_results = {}
-        view = self._ensure_view()
-        n_active = self.n_lanes
-        if insert is not None:
-            n_active = len(insert)
-            for lane, data in enumerate(insert):
-                with self._bound(view, lane):
-                    target.insert_testcase(self, data)
-            for lane in range(n_active, self.n_lanes):
-                view.set_status(lane, StatusCode.OK)  # idle lanes
-        runner.push(view)
-        self._view = None
+        spans = self.registry.spans
+        with spans.span("insert"):
+            view = self._ensure_view()
+            n_active = self.n_lanes
+            if insert is not None:
+                n_active = len(insert)
+                for lane, data in enumerate(insert):
+                    with self._bound(view, lane):
+                        target.insert_testcase(self, data)
+                for lane in range(n_active, self.n_lanes):
+                    view.set_status(lane, StatusCode.OK)  # idle lanes
+            runner.push(view)
+            self._view = None
         statuses = runner.run(bp_handler=self._dispatch_bp)
 
         # coverage merge on device (timeouts revoked like the reference
         # client, and OVERLAY_FULL lanes excluded — they ran on truncated
         # memory, their coverage is not trustworthy)
-        m = runner.machine
-        include = jnp.asarray(
-            (statuses != int(StatusCode.TIMEDOUT))
-            & (statuses != int(StatusCode.OVERLAY_FULL))
-            & (np.arange(self.n_lanes) < n_active))
-        self._agg_cov, self._agg_edge, new_lane, new_words = _merge_coverage(
-            self._agg_cov, self._agg_edge, m.cov, m.edge, include)
-        self._new_lane = np.asarray(new_lane)
-        self._last_new_words = np.asarray(new_words)
-        self.stats["batches"] += 1
-        self.stats["testcases"] += n_active
-        self.stats["instructions"] += int(
-            np.asarray(m.icount)[:n_active].sum())
+        with spans.span("cov-readback") as sp:
+            m = runner.machine
+            include = jnp.asarray(
+                (statuses != int(StatusCode.TIMEDOUT))
+                & (statuses != int(StatusCode.OVERLAY_FULL))
+                & (np.arange(self.n_lanes) < n_active))
+            (self._agg_cov, self._agg_edge, new_lane,
+             new_words) = _merge_coverage(
+                self._agg_cov, self._agg_edge, m.cov, m.edge, include)
+            self._new_lane = np.asarray(new_lane)
+            self._last_new_words = np.asarray(new_words)
+            self.stats["batches"] += 1
+            self.stats["testcases"] += n_active
+            self.stats["instructions"] += int(
+                np.asarray(m.icount)[:n_active].sum())
+            # fold the device telemetry block exactly once per burst
+            runner.fold_device_counters()
+            sp.fence(self._agg_cov)
 
         return [self._map_result(lane, statuses[lane])
                 for lane in range(n_active)]
@@ -257,6 +275,7 @@ class TpuBackend(Backend):
         self.stats["batches"] += 1
         self.stats["testcases"] += 1
         self.stats["instructions"] += int(np.asarray(m.icount)[0])
+        self.runner.fold_device_counters()
         return self._map_result(0, statuses[0])
 
     def _run_traced(self) -> TestcaseResult:

@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import argparse
 import importlib
+import logging
 import random
 import sys
 import time
+from contextlib import contextmanager
 from pathlib import Path
 from typing import List, Optional
 
@@ -34,6 +36,7 @@ from wtf_tpu.config import (
 )
 from wtf_tpu.core.results import Crash
 from wtf_tpu.harness.targets import Targets, load_builtin_targets
+from wtf_tpu.telemetry import Registry, open_event_log
 
 
 def _add_paths(p: argparse.ArgumentParser) -> None:
@@ -44,6 +47,11 @@ def _add_paths(p: argparse.ArgumentParser) -> None:
     p.add_argument("--outputs", type=Path, default=None)
     p.add_argument("--crashes", type=Path, default=None)
     p.add_argument("--state", type=Path, default=None)
+    p.add_argument("--telemetry-dir", type=Path, default=None,
+                   help="write machine-readable telemetry (events.jsonl: "
+                        "run-start/heartbeat/new-coverage/crash/timeout/"
+                        "compile/run-end records with a full metrics dump; "
+                        "summarize with tools/telemetry_report.py)")
 
 
 def _add_target_selection(p: argparse.ArgumentParser) -> None:
@@ -143,22 +151,48 @@ def _lookup_target(args):
     return Targets.instance().get(args.name)
 
 
+@contextmanager
+def _telemetry_for(args):
+    """One registry + one event sink per CLI invocation, wired into the
+    backend, the campaign driver, and the heartbeat — the 'unified'
+    in unified telemetry.  A fresh Registry (not the process-global one)
+    so repeated in-process invocations don't bleed counters.  Context
+    manager so the `JSONL always ends with run-end` invariant is
+    structural: run-start on entry, run-end + close on ANY exit —
+    including a failed backend build."""
+    registry = Registry()
+    events = open_event_log(getattr(args, "telemetry_dir", None))
+    events.emit("run-start", subcommand=args.subcommand,
+                name=getattr(args, "name", None),
+                backend=getattr(args, "backend", None),
+                argv=getattr(args, "_argv", None))
+    try:
+        yield registry, events
+    finally:
+        events.emit("run-end", metrics=registry.dump())
+        events.close()
+
+
 def _build_backend(target, backend_name: str, paths: TargetPaths,
-                   limit: int, lanes: int):
+                   limit: int, lanes: int, registry=None, events=None):
     from wtf_tpu.backend import create_backend
     from wtf_tpu.snapshot.loader import load_snapshot
 
-    if paths.state and Path(paths.state).exists():
-        snapshot = load_snapshot(paths.state)
-    elif target.snapshot is not None:
-        snapshot = target.snapshot()
-    else:
-        raise SystemExit(
-            f"target {target.name!r} has no snapshot factory and no "
-            f"--state dir was given")
+    registry = registry if registry is not None else Registry()
+    with registry.spans.span("snapshot-load"):
+        if paths.state and Path(paths.state).exists():
+            snapshot = load_snapshot(paths.state)
+        elif target.snapshot is not None:
+            snapshot = target.snapshot()
+        else:
+            raise SystemExit(
+                f"target {target.name!r} has no snapshot factory and no "
+                f"--state dir was given")
     kwargs = {"n_lanes": lanes} if backend_name == "tpu" else {}
-    backend = create_backend(backend_name, snapshot, limit=limit, **kwargs)
-    backend.initialize()
+    backend = create_backend(backend_name, snapshot, limit=limit,
+                             registry=registry, events=events, **kwargs)
+    with registry.spans.span("init"):
+        backend.initialize()
     return backend
 
 
@@ -183,39 +217,43 @@ def cmd_run(args) -> int:
                       trace_type=args.trace_type, lanes=args.lanes,
                       paths=_paths_from(args))
     target = _lookup_target(args)
-    backend = _build_backend(target, opts.backend, opts.paths,
-                             opts.limit, opts.lanes)
-    target.init(backend)
-
-    inputs: List[Path] = (
-        sorted(p for p in opts.input.iterdir() if p.is_file())
-        if opts.input.is_dir() else [opts.input])
-    trace_dir = (opts.trace_path
-                 if opts.trace_path and len(inputs) > 1 else None)
-    if trace_dir:
-        trace_dir.mkdir(parents=True, exist_ok=True)
-
     crashes = 0
-    for path in inputs:
-        data = path.read_bytes()
-        for _ in range(max(opts.runs, 1)):
-            if opts.trace_path:
-                trace_file = (trace_dir / f"{path.name}.trace"
-                              if trace_dir else opts.trace_path)
-                backend.set_trace_file(trace_file, opts.trace_type)
-            result, coverage = run_testcase_and_restore(
-                backend, target, data)
-            if isinstance(result, Crash):
-                crashes += 1
-            print(f"{path.name}: {result} (|cov| = {len(coverage)})")
-    backend.print_run_stats()
-    if args.coverage is not None:
-        from wtf_tpu.utils.covfiles import parse_cov_files
+    with _telemetry_for(args) as (registry, events):
+        backend = _build_backend(target, opts.backend, opts.paths,
+                                 opts.limit, opts.lanes,
+                                 registry=registry, events=events)
+        target.init(backend)
 
-        wanted = parse_cov_files(args.coverage)
-        covered = backend.aggregate_coverage() & wanted
-        print(f"coverage: {len(covered)}/{len(wanted)} "
-              f"listed basic blocks hit")
+        inputs: List[Path] = (
+            sorted(p for p in opts.input.iterdir() if p.is_file())
+            if opts.input.is_dir() else [opts.input])
+        trace_dir = (opts.trace_path
+                     if opts.trace_path and len(inputs) > 1 else None)
+        if trace_dir:
+            trace_dir.mkdir(parents=True, exist_ok=True)
+
+        for path in inputs:
+            data = path.read_bytes()
+            for _ in range(max(opts.runs, 1)):
+                if opts.trace_path:
+                    trace_file = (trace_dir / f"{path.name}.trace"
+                                  if trace_dir else opts.trace_path)
+                    backend.set_trace_file(trace_file, opts.trace_type)
+                result, coverage = run_testcase_and_restore(
+                    backend, target, data)
+                if isinstance(result, Crash):
+                    crashes += 1
+                    events.emit("crash", name=result.name,
+                                input=path.name)
+                print(f"{path.name}: {result} (|cov| = {len(coverage)})")
+        backend.print_run_stats()
+        if args.coverage is not None:
+            from wtf_tpu.utils.covfiles import parse_cov_files
+
+            wanted = parse_cov_files(args.coverage)
+            covered = backend.aggregate_coverage() & wanted
+            print(f"coverage: {len(covered)}/{len(wanted)} "
+                  f"listed basic blocks hit")
     return 0 if crashes == 0 else 2
 
 
@@ -227,13 +265,19 @@ def cmd_fuzz(args) -> int:
                        seed=args.seed, lanes=args.lanes,
                        paths=_paths_from(args))
     target = _lookup_target(args)
-    backend = _build_backend(target, opts.backend, opts.paths,
-                             opts.limit, opts.lanes)
-    if opts.backend == "tpu":
-        node = BatchClient(backend, target, opts.address, mux=args.mux)
-    else:
-        node = Client(backend, target, opts.address)
-    served = node.run()
+    with _telemetry_for(args) as (registry, events):
+        backend = _build_backend(target, opts.backend, opts.paths,
+                                 opts.limit, opts.lanes,
+                                 registry=registry, events=events)
+        if opts.backend == "tpu":
+            node = BatchClient(backend, target, opts.address, mux=args.mux,
+                               registry=registry, events=events,
+                               print_stats=True)
+        else:
+            node = Client(backend, target, opts.address,
+                          registry=registry, events=events,
+                          print_stats=True)
+        served = node.run()
     print(f"node served {served} testcases")
     return 0
 
@@ -246,16 +290,19 @@ def cmd_master(args) -> int:
                          runs=args.runs, max_len=args.max_len,
                          seed=args.seed, paths=_paths_from(args))
     target = _lookup_target(args)
-    rng = random.Random(opts.seed or None)
-    corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
-    coverage_path = (Path(opts.paths.target) / "coverage.cov"
-                     if opts.paths.target else None)
-    server = Server(opts.address, _mutator_for(target, rng, opts.max_len),
-                    corpus, inputs_dir=opts.paths.inputs,
-                    crashes_dir=opts.paths.crashes, runs=opts.runs,
-                    max_len=opts.max_len, print_stats=True,
-                    coverage_path=coverage_path)
-    stats = server.run()
+    with _telemetry_for(args) as (registry, events):
+        rng = random.Random(opts.seed or None)
+        corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
+        coverage_path = (Path(opts.paths.target) / "coverage.cov"
+                         if opts.paths.target else None)
+        server = Server(opts.address,
+                        _mutator_for(target, rng, opts.max_len),
+                        corpus, inputs_dir=opts.paths.inputs,
+                        crashes_dir=opts.paths.crashes, runs=opts.runs,
+                        max_len=opts.max_len, print_stats=True,
+                        coverage_path=coverage_path,
+                        registry=registry, events=events)
+        stats = server.run()
     print(server.stats.line(len(server.coverage), len(corpus), 0))
     return 0 if stats.crashes == 0 else 2
 
@@ -283,54 +330,60 @@ def cmd_campaign(args) -> int:
                        num_processes=args.num_processes,
                        process_id=args.process_id)
     target = _lookup_target(args)
-    backend = _build_backend(target, opts.backend, opts.paths,
-                             opts.limit, opts.lanes)
-    target.init(backend)
-    rng = random.Random(opts.seed or None)
-    # minset (--runs=0) fills its corpus from ONE merged scan below (no
-    # double read of inputs/); fuzz mode loads inputs and persists
-    # coverage-increasing finds into outputs/
-    if opts.runs == 0:
-        corpus = Corpus(rng=rng)
-    elif opts.paths.inputs and Path(opts.paths.inputs).is_dir():
-        corpus = Corpus.load_dir(opts.paths.inputs, rng=rng,
-                                 outputs_dir=opts.paths.outputs)
-    else:
-        corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
-    loop = FuzzLoop(backend, target, _mutator_for(target, rng, opts.max_len),
-                    corpus, crashes_dir=opts.paths.crashes)
-    if opts.runs == 0:
-        # reference semantics (server.h:552-556): replay the seeds — plus
-        # any prior campaign's outputs/, so a corpus can minimize itself —
-        # and leave outputs/ holding exactly the coverage-minimal subset.
-        # ONE walk feeds both the corpus (through the shared size-sorted
-        # replay-ordering policy; add_digested dedups) and the prune
-        # snapshot (pre-dedup census of outputs/); files appearing after
-        # this walk were never measured and stay untouched
-        from wtf_tpu.fuzz.corpus import seed_paths
+    with _telemetry_for(args) as (registry, events):
+        backend = _build_backend(target, opts.backend, opts.paths,
+                                 opts.limit, opts.lanes,
+                                 registry=registry, events=events)
+        target.init(backend)
+        rng = random.Random(opts.seed or None)
+        # minset (--runs=0) fills its corpus from ONE merged scan below
+        # (no double read of inputs/); fuzz mode loads inputs and
+        # persists coverage-increasing finds into outputs/
+        if opts.runs == 0:
+            corpus = Corpus(rng=rng)
+        elif opts.paths.inputs and Path(opts.paths.inputs).is_dir():
+            corpus = Corpus.load_dir(opts.paths.inputs, rng=rng,
+                                     outputs_dir=opts.paths.outputs)
+        else:
+            corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
+        loop = FuzzLoop(backend, target,
+                        _mutator_for(target, rng, opts.max_len),
+                        corpus, crashes_dir=opts.paths.crashes,
+                        registry=registry, events=events)
+        if opts.runs == 0:
+            # reference semantics (server.h:552-556): replay the seeds —
+            # plus any prior campaign's outputs/, so a corpus can minimize
+            # itself — and leave outputs/ holding exactly the
+            # coverage-minimal subset.  ONE walk feeds both the corpus
+            # (through the shared size-sorted replay-ordering policy;
+            # add_digested dedups) and the prune snapshot (pre-dedup census
+            # of outputs/); files appearing after this walk were never
+            # measured and stay untouched
+            from wtf_tpu.fuzz.corpus import seed_paths
 
-        out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
-        outputs_snapshot = []
-        for p, digest, data in seed_paths(
-                [opts.paths.inputs, opts.paths.outputs],
-                with_data=True, keep_dups=True):
-            corpus.add_digested(data, digest)
-            if out_dir and p.parent == out_dir:
-                outputs_snapshot.append((p, digest))
-        kept = loop.minset(opts.paths.outputs, print_stats=True)
-        # outputs/ ends as exactly the kept subset of what was measured:
-        # every snapshot file's content was replayed (directly or via a
-        # content-identical twin), so prune by content digest
-        for p, digest in outputs_snapshot:
-            if not (digest in kept.digests and p.name == digest):
-                p.unlink(missing_ok=True)
-        print(loop.stats.line(len(corpus), loop._coverage()))
-        print(f"minset: kept {len(kept)}/{len(corpus)} seeds")
-        return 0 if loop.stats.crashes == 0 else 2
-    stats = loop.fuzz(runs=opts.runs, print_stats=True,
-                      stop_on_crash=opts.stop_on_crash)
-    print(stats.line(len(corpus), loop._coverage()))
-    return 0 if stats.crashes == 0 else 2
+            out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
+            outputs_snapshot = []
+            for p, digest, data in seed_paths(
+                    [opts.paths.inputs, opts.paths.outputs],
+                    with_data=True, keep_dups=True):
+                corpus.add_digested(data, digest)
+                if out_dir and p.parent == out_dir:
+                    outputs_snapshot.append((p, digest))
+            kept = loop.minset(opts.paths.outputs, print_stats=True)
+            # outputs/ ends as exactly the kept subset of what was
+            # measured: every snapshot file's content was replayed
+            # (directly or via a content-identical twin), so prune by
+            # content digest
+            for p, digest in outputs_snapshot:
+                if not (digest in kept.digests and p.name == digest):
+                    p.unlink(missing_ok=True)
+            print(loop.stats.line(len(corpus), loop._coverage()))
+            print(f"minset: kept {len(kept)}/{len(corpus)} seeds")
+            return 0 if loop.stats.crashes == 0 else 2
+        stats = loop.fuzz(runs=opts.runs, print_stats=True,
+                          stop_on_crash=opts.stop_on_crash)
+        print(stats.line(len(corpus), loop._coverage()))
+        return 0 if stats.crashes == 0 else 2
 
 
 def cmd_snapshot(args) -> int:
@@ -364,7 +417,25 @@ def cmd_snapshot(args) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # Operational failures (crash-save, coverage-write, malformed-frame —
+    # the bare-print replacements) go through `logging`; a message-only
+    # handler on stdout keeps them stream-stable with the prints they
+    # replaced.  Scoped to the wtf_tpu logger, NOT the root logger:
+    # third-party WARNINGs (jax/absl) must not leak bare into the
+    # parseable stdout stream.  Heartbeat lines themselves stay print()
+    # (CampaignStats.maybe_heartbeat) so they reach stdout even without
+    # this config.  Handlers are rebound to the CURRENT stdout on every
+    # invocation (pytest capture swaps streams between in-process calls).
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    wlog = logging.getLogger("wtf_tpu")
+    wlog.handlers[:] = [handler]
+    wlog.setLevel(logging.INFO)
+    wlog.propagate = False
     args = build_parser().parse_args(argv)
+    # the argv actually parsed (programmatic main(argv) included) — the
+    # provenance recorded in the run-start telemetry event
+    args._argv = list(argv) if argv is not None else sys.argv[1:]
     driver = {
         "run": cmd_run,
         "fuzz": cmd_fuzz,

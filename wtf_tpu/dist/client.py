@@ -25,7 +25,28 @@ from typing import List, Optional, Set, Tuple
 
 from wtf_tpu.core.results import TestcaseResult, Timedout
 from wtf_tpu.dist import wire
-from wtf_tpu.utils.human import number_to_human
+from wtf_tpu.fuzz.loop import CampaignStats
+from wtf_tpu import telemetry
+from wtf_tpu.telemetry import Registry
+
+
+class _NodeTelemetry:
+    """Shared node-side telemetry: the same `campaign.*` counters and
+    heartbeat line shape as the fused loop/master (cov/corp omitted — a
+    node doesn't track them), wired identically for both node shapes."""
+
+    def _init_telemetry(self, backend, registry, events,
+                        stats_every: float, print_stats: bool) -> None:
+        self.registry, self.events = telemetry.resolve(
+            backend, registry, events)
+        self.stats = CampaignStats(self.registry)
+        self.stats_every = stats_every
+        self.print_stats = print_stats
+
+    def _heartbeat(self) -> None:
+        self.stats.maybe_heartbeat(self.events, self.registry,
+                                   every=self.stats_every,
+                                   print_stats=self.print_stats)
 
 
 def run_testcase_and_restore(backend, target, data: bytes,
@@ -41,14 +62,18 @@ def run_testcase_and_restore(backend, target, data: bytes,
     return result, coverage
 
 
-class Client:
+class Client(_NodeTelemetry):
     """Single-slot node (reference shape)."""
 
-    def __init__(self, backend, target, address: str):
+    def __init__(self, backend, target, address: str,
+                 registry: Optional[Registry] = None, events=None,
+                 stats_every: float = 10.0, print_stats: bool = False):
         self.backend = backend
         self.target = target
         self.address = address
         self.runs = 0
+        self._init_telemetry(backend, registry, events, stats_every,
+                             print_stats)
 
     def run(self, max_runs: int = 0) -> int:
         """Serve until the master closes (or max_runs served)."""
@@ -65,18 +90,20 @@ class Client:
                     break  # master gone: node exits (client.cc:228-231)
                 result, coverage = run_testcase_and_restore(
                     self.backend, self.target, testcase)
+                self.stats.account(result)
                 try:
                     wire.send_msg(
                         sock, wire.encode_result(testcase, coverage, result))
                 except OSError:
                     break  # master hung up mid-report (shutdown race)
                 self.runs += 1
+                self._heartbeat()
         finally:
             sock.close()
         return self.runs
 
 
-class BatchClient:
+class BatchClient(_NodeTelemetry):
     """TPU node: one device batch per round against the master.
 
     Two wire shapes (selected by `mux`):
@@ -89,13 +116,17 @@ class BatchClient:
                  4096-lane node: 1 fd instead of 4096.
     """
 
-    def __init__(self, backend, target, address: str, mux: bool = False):
+    def __init__(self, backend, target, address: str, mux: bool = False,
+                 registry: Optional[Registry] = None, events=None,
+                 stats_every: float = 10.0, print_stats: bool = False):
         self.backend = backend
         self.target = target
         self.address = address
         self.mux = mux
         self.rounds = 0
         self.runs = 0
+        self._init_telemetry(backend, registry, events, stats_every,
+                             print_stats)
 
     def run(self, max_rounds: int = 0) -> int:
         if self.mux:
@@ -133,6 +164,7 @@ class BatchClient:
                         coverage = set()  # revoked (client.cc:122-125)
                     elif not self.backend.lane_found_new_coverage(lane):
                         coverage = set()  # nothing new to report
+                    self.stats.account(result)
                     try:
                         wire.send_msg(
                             sock, wire.encode_result(data, coverage, result))
@@ -145,6 +177,7 @@ class BatchClient:
                 self.target.restore()
                 self.backend.restore()
                 self.rounds += 1
+                self._heartbeat()
         finally:
             for sock in socks:
                 sock.close()
@@ -174,6 +207,7 @@ class BatchClient:
                         coverage = set()  # revoked (client.cc:122-125)
                     elif not self.backend.lane_found_new_coverage(lane):
                         coverage = set()  # nothing new to report
+                    self.stats.account(result)
                     replies.append(
                         wire.encode_result(data, coverage, result))
                     self.runs += 1
@@ -184,6 +218,7 @@ class BatchClient:
                 self.target.restore()
                 self.backend.restore()
                 self.rounds += 1
+                self._heartbeat()
         finally:
             sock.close()
         return self.runs

@@ -20,6 +20,7 @@ Reference `Server_t` (src/wtf/server.h): a single-threaded select() reactor
 from __future__ import annotations
 
 import hashlib
+import logging
 import re
 import selectors
 import socket
@@ -29,25 +30,26 @@ from collections import deque
 from pathlib import Path
 from typing import Deque, Dict, List, Optional, Set
 
-from wtf_tpu.core.results import Cr3Change, Crash, OverlayFull, Timedout
+from wtf_tpu.core.results import OverlayFull
 from wtf_tpu.dist import wire
 from wtf_tpu.fuzz.corpus import Corpus
+from wtf_tpu.fuzz.loop import CampaignStats
 from wtf_tpu.fuzz.mutator import Mutator
+from wtf_tpu.telemetry import NULL, Registry
 from wtf_tpu.utils.human import number_to_human, seconds_to_human
 
+log = logging.getLogger(__name__)
 
-class ServerStats:
-    """Status-line counters (reference ServerStats_t, server.h:24-240)."""
 
-    def __init__(self):
-        self.testcases = 0
-        self.crashes = 0
-        self.timeouts = 0
-        self.cr3s = 0
-        self.overlay_fulls = 0
+class ServerStats(CampaignStats):
+    """Status-line counters (reference ServerStats_t, server.h:24-240).
+    Registry-backed via CampaignStats — the master's numbers live in the
+    same `campaign.*` namespace the fused loop uses, so one report tool
+    reads both — plus the master-only lastcov age."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        super().__init__(registry)
         self.last_cov = time.time()
-        self.start = time.time()
-        self.last_print = 0.0
 
     def line(self, cov: int, corpus_len: int, clients: int) -> str:
         dt = time.time() - self.start
@@ -86,6 +88,8 @@ class Server:
         stats_every: float = 10.0,
         print_stats: bool = False,
         coverage_path: Optional[Path] = None,
+        registry: Optional[Registry] = None,
+        events=None,
     ):
         self.address = address
         self.mutator = mutator
@@ -96,7 +100,9 @@ class Server:
         self.runs = runs
         self.max_len = max_len
         self.coverage_path = Path(coverage_path) if coverage_path else None
-        self.stats = ServerStats()
+        self.registry = registry if registry is not None else Registry()
+        self.events = events if events is not None else NULL
+        self.stats = ServerStats(self.registry)
         self.stats_every = stats_every
         self.print_stats = print_stats
         # seed queue: inputs/ plus any prior campaign's outputs/ — a
@@ -183,15 +189,16 @@ class Server:
         self._account_result(*wire.decode_result(body))
 
     def _account_result(self, testcase, coverage, result) -> None:
-        self.stats.testcases += 1
         new = coverage - self.coverage
         if new:
             self.coverage |= new
             self.stats.last_cov = time.time()
-            self.mutator.on_new_coverage(testcase)
+            self.stats.new_coverage += 1  # same per-testcase semantics as
+            self.mutator.on_new_coverage(testcase)  # FuzzLoop's counter
             self.corpus.add(testcase)
-        if isinstance(result, Crash):
-            self.stats.crashes += 1
+            self.events.emit("new-coverage", new_addresses=len(new),
+                             total=len(self.coverage), size=len(testcase))
+        if self.stats.account(result):
             if result.name:
                 # the name crossed the WIRE: whitelist-sanitize before
                 # using it as a filename (a hostile node must not steer
@@ -199,21 +206,20 @@ class Server:
                 # down open() with ValueError, not OSError)
                 name = re.sub(r"[^A-Za-z0-9._-]", "_",
                               result.name).lstrip(".")[:200] or "crash-unnamed"
+                self.events.emit("crash", name=name, size=len(testcase),
+                                 new=name not in self.crash_names)
                 self.crash_names.add(name)
                 if self.crashes_dir:
                     try:
                         (self.crashes_dir / name).write_bytes(testcase)
                     except (OSError, ValueError) as e:
-                        print(f"crash save failed for {name!r}: {e}")
-        elif isinstance(result, Timedout):
-            self.stats.timeouts += 1
-        elif isinstance(result, Cr3Change):
-            self.stats.cr3s += 1
+                        log.warning("crash save failed for %r: %s", name, e)
+                        self.events.emit("error", kind="crash-save",
+                                         name=name, detail=str(e))
         elif isinstance(result, OverlayFull):
             # node resource limit, not a finding: requeue ONCE for an
             # honest re-run (ideally on a node with more overlay slots);
             # never saved under crashes/, never bounced forever
-            self.stats.overlay_fulls += 1
             digest = hashlib.blake2b(testcase, digest_size=16).hexdigest()
             if digest not in self._ovf_requeued:
                 self._ovf_requeued.add(digest)
@@ -295,7 +301,9 @@ class Server:
                 "addresses": sorted(self.coverage),
             }))
         except OSError as e:
-            print(f"coverage.cov write failed: {e}")
+            log.warning("coverage.cov write failed: %s", e)
+            self.events.emit("error", kind="coverage-write",
+                             path=str(self.coverage_path), detail=str(e))
 
     def _set_writable(self, sock: socket.socket, want: bool) -> None:
         events = selectors.EVENT_READ | (selectors.EVENT_WRITE if want else 0)
@@ -362,8 +370,11 @@ class Server:
             # take the master down — drop it, requeue its in-flight work.
             # Loudly: if every node trips this, the fleet has a wire
             # mismatch and the operator needs to see it.
-            print(f"dropping node (malformed result frame: {e!r}); "
-                  f"requeueing {len(conn.inflight)} in-flight testcase(s)")
+            log.warning("dropping node (malformed result frame: %r); "
+                        "requeueing %d in-flight testcase(s)",
+                        e, len(conn.inflight))
+            self.events.emit("error", kind="malformed-frame",
+                             detail=repr(e), requeued=len(conn.inflight))
             self._drop(sock)
             return
         for item in decoded:
@@ -383,9 +394,9 @@ class Server:
         sock.close()
 
     def _maybe_print(self) -> None:
-        now = time.time()
-        if (self.print_stats
-                and now - self.stats.last_print >= self.stats_every):
-            self.stats.last_print = now
-            print(self.stats.line(len(self.coverage), len(self.corpus),
-                                  len(self._clients)))
+        self.stats.maybe_heartbeat(
+            self.events, self.registry,
+            lambda: self.stats.line(len(self.coverage), len(self.corpus),
+                                    len(self._clients)),
+            every=self.stats_every, print_stats=self.print_stats,
+            nodes=len(self._clients))

@@ -12,33 +12,60 @@ Per batch (the batched RunTestcaseAndRestore, client.cc:88-180):
   3. harvest: new-coverage lanes -> corpus + mutator cross-over seed;
      crashes -> crashes/<name>; timeouts already coverage-revoked
   4. target.restore + backend.restore
+
+Telemetry: every batch phase is a span (mutate / execute / harvest /
+restore — they tile run_one_batch, so their totals account for the
+campaign's wall-clock), counters live in the metrics registry behind
+`CampaignStats`, and crash / new-coverage / timeout / heartbeat records
+land in the JSONL event log when one is wired.
 """
 
 from __future__ import annotations
 
-import random
 import time
 from pathlib import Path
 from typing import Optional
 
-from wtf_tpu.core.results import Crash, Cr3Change, Ok, OverlayFull, Timedout
+from wtf_tpu.core.results import (
+    Cr3Change, Crash, OverlayFull, TestcaseResult, Timedout,
+)
 from wtf_tpu.fuzz.corpus import Corpus
 from wtf_tpu.fuzz.mutator import Mutator
+from wtf_tpu import telemetry
+from wtf_tpu.telemetry import NULL, Registry
 from wtf_tpu.utils.hashing import hex_digest
 from wtf_tpu.utils.human import seconds_to_human
 
 
+def _campaign_counter(name: str):
+    """Property proxying one `campaign.<name>` registry counter, so the
+    reference-shaped attribute API (`stats.crashes += 1`) stays while the
+    value lives in the registry (one namespace for the heartbeat line,
+    the JSONL dump, and print_run_stats)."""
+    key = f"campaign.{name}"
+
+    def fget(self):
+        return self.registry.counter(key).value
+
+    def fset(self, value):
+        self.registry.counter(key).set(value)
+
+    return property(fget, fset)
+
+
 class CampaignStats:
     """Counters behind the status line (reference ServerStats_t / client
-    stats, server.h:24-240, client.cc:7-84)."""
+    stats, server.h:24-240, client.cc:7-84), registry-backed."""
 
-    def __init__(self):
-        self.testcases = 0
-        self.crashes = 0
-        self.timeouts = 0
-        self.cr3s = 0
-        self.overlay_fulls = 0
-        self.new_coverage = 0
+    testcases = _campaign_counter("testcases")
+    crashes = _campaign_counter("crashes")
+    timeouts = _campaign_counter("timeouts")
+    cr3s = _campaign_counter("cr3s")
+    overlay_fulls = _campaign_counter("overlay_fulls")
+    new_coverage = _campaign_counter("new_coverage")
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry if registry is not None else Registry()
         self.start = time.time()
         self.last_print = 0.0
 
@@ -46,13 +73,62 @@ class CampaignStats:
         dt = time.time() - self.start
         return self.testcases / dt if dt > 0 else 0.0
 
-    def line(self, corpus_len: int, cov: int) -> str:
+    def account(self, result: TestcaseResult) -> bool:
+        """Count one testcase result; returns True for a crash (saving /
+        requeueing is the caller's business).  The ONE accounting path
+        shared by fuzz, minset, the dist master, and the dist clients."""
+        self.testcases += 1
+        if isinstance(result, Timedout):
+            self.timeouts += 1
+        elif isinstance(result, Cr3Change):
+            self.cr3s += 1
+        elif isinstance(result, OverlayFull):
+            self.overlay_fulls += 1
+        elif isinstance(result, Crash):
+            self.crashes += 1
+            return True
+        return False
+
+    def line(self, corpus_len: Optional[int] = None,
+             cov: Optional[int] = None) -> str:
+        """The human heartbeat line (format stable — downstream eyeballs
+        and scripts parse it).  cov/corp are omitted by callers that
+        don't track them (dist clients)."""
         uptime = seconds_to_human(time.time() - self.start)
         ovf = f" ovf: {self.overlay_fulls}" if self.overlay_fulls else ""
-        return (f"#{self.testcases} cov: {cov} corp: {corpus_len} "
+        mid = ""
+        if cov is not None:
+            mid += f"cov: {cov} "
+        if corpus_len is not None:
+            mid += f"corp: {corpus_len} "
+        return (f"#{self.testcases} {mid}"
                 f"exec/s: {self.execs_per_sec():.1f} "
                 f"crash: {self.crashes} timeout: {self.timeouts} "
                 f"cr3: {self.cr3s}{ovf} uptime: {uptime}")
+
+    def maybe_heartbeat(self, events, registry=None, line_fn=None,
+                        every: float = 10.0, print_stats: bool = False,
+                        **fields) -> Optional[str]:
+        """Throttled heartbeat — the ONE emission path shared by the fused
+        loop, the dist master, and the dist nodes: at most one per `every`
+        seconds, print() the human line when asked (print, not logging —
+        the line must reach stdout even for library callers that never
+        configure logging), and land a JSONL heartbeat record carrying
+        the full registry dump.  Returns the line when one was emitted."""
+        if not print_stats and (events is None or type(events) is type(NULL)):
+            # nobody consumes the line: skip building it — line_fn can
+            # cost a device coverage readback.  Exact-type check: EventLog
+            # SUBCLASSES NullEventLog and must not match.
+            return None
+        now = time.time()
+        if now - self.last_print < every:
+            return None
+        self.last_print = now
+        line = line_fn() if line_fn is not None else self.line()
+        if print_stats:
+            print(line)
+        events.heartbeat(registry, line=line, **fields)
+        return line
 
 
 class FuzzLoop:
@@ -65,6 +141,8 @@ class FuzzLoop:
         crashes_dir: Optional[Path] = None,
         batch_size: Optional[int] = None,
         stats_every: float = 10.0,
+        registry: Optional[Registry] = None,
+        events=None,
     ):
         self.backend = backend
         self.target = target
@@ -74,7 +152,11 @@ class FuzzLoop:
         if self.crashes_dir:
             self.crashes_dir.mkdir(parents=True, exist_ok=True)
         self.batch_size = batch_size or getattr(backend, "n_lanes", 1)
-        self.stats = CampaignStats()
+        # default onto the BACKEND's registry/events so runner spans nest
+        # under this loop's execute phase and one dump carries everything
+        self.registry, self.events = telemetry.resolve(
+            backend, registry, events)
+        self.stats = CampaignStats(self.registry)
         self.stats_every = stats_every
         self.crash_names = set()
         # overlay-exhausted testcases get ONE honest re-run (they executed
@@ -83,49 +165,76 @@ class FuzzLoop:
         self._requeue: list = []
         self._requeue_digests = set()
 
-    def run_one_batch(self) -> int:
-        """Returns the number of crashes found in this batch."""
-        requeued, self._requeue = self._requeue[:self.batch_size], []
-        fresh = self.batch_size - len(requeued)
-        if hasattr(self.mutator, "get_new_batch"):
-            # native engines mutate the whole batch in one C call
-            testcases = requeued + (self.mutator.get_new_batch(
-                self.corpus, fresh) if fresh else [])
-        else:
-            testcases = requeued + [
-                self.mutator.get_new_testcase(self.corpus)
-                for _ in range(fresh)]
-        results = self.backend.run_batch(testcases, self.target)
-        crashes = 0
-        for lane, (data, result) in enumerate(zip(testcases, results)):
-            self.stats.testcases += 1
-            if isinstance(result, Timedout):
-                self.stats.timeouts += 1
-            elif isinstance(result, Cr3Change):
-                self.stats.cr3s += 1
-            elif isinstance(result, OverlayFull):
-                self.stats.overlay_fulls += 1
+    def _account(self, data: bytes, result: TestcaseResult,
+                 requeue: bool = False) -> int:
+        """Per-result accounting shared by fuzz and minset (they used to
+        carry copy-pasted blocks of this): counters via CampaignStats,
+        crash saving + events, optional overlay-full requeue.  Returns 1
+        for a crash so batch loops can sum."""
+        if not self.stats.account(result):
+            if requeue and isinstance(result, OverlayFull):
                 digest = hex_digest(data)
                 if digest not in self._requeue_digests:
                     self._requeue_digests.add(digest)
                     self._requeue.append(data)
-            elif isinstance(result, Crash):
-                self.stats.crashes += 1
-                crashes += 1
-                self._save_crash(data, result)
-            if self.backend.lane_found_new_coverage(lane):
-                self.stats.new_coverage += 1
-                if self.corpus.add(data):
-                    self.mutator.on_new_coverage(data)
-        self.target.restore()
-        self.backend.restore()
+            return 0
+        self._save_crash(data, result)
+        return 1
+
+    def run_one_batch(self) -> int:
+        """Returns the number of crashes found in this batch."""
+        spans = self.registry.spans
+        with spans.span("mutate"):
+            requeued, self._requeue = \
+                self._requeue[:self.batch_size], []
+            fresh = self.batch_size - len(requeued)
+            if hasattr(self.mutator, "get_new_batch"):
+                # native engines mutate the whole batch in one C call
+                testcases = requeued + (self.mutator.get_new_batch(
+                    self.corpus, fresh) if fresh else [])
+            else:
+                testcases = requeued + [
+                    self.mutator.get_new_testcase(self.corpus)
+                    for _ in range(fresh)]
+        with spans.span("execute"):
+            results = self.backend.run_batch(testcases, self.target)
+        crashes = 0
+        timeouts_before = self.stats.timeouts
+        with spans.span("harvest"):
+            for lane, (data, result) in enumerate(zip(testcases, results)):
+                crashes += self._account(data, result, requeue=True)
+                if self.backend.lane_found_new_coverage(lane):
+                    self.stats.new_coverage += 1
+                    if self.corpus.add(data):
+                        self.mutator.on_new_coverage(data)
+                        self.events.emit("new-coverage",
+                                         digest=hex_digest(data),
+                                         size=len(data))
+        timeouts = self.stats.timeouts - timeouts_before
+        if timeouts:
+            # aggregated: one record per batch, not one per timed-out lane
+            self.events.emit("timeout", count=timeouts)
+        with spans.span("restore"):
+            self.target.restore()
+            self.backend.restore()
         return crashes
 
     def _save_crash(self, data: bytes, result: Crash) -> None:
         name = result.name or f"crash-{hex_digest(data)[:16]}"
+        new = name not in self.crash_names
         self.crash_names.add(name)
         if self.crashes_dir:
             (self.crashes_dir / name).write_bytes(data)
+        self.events.emit("crash", name=name, size=len(data), new=new)
+
+    def _heartbeat(self, print_stats: bool) -> None:
+        """stats_every cadence: the stable human line + one JSONL
+        heartbeat carrying the full registry dump (per-phase span totals
+        included)."""
+        self.stats.maybe_heartbeat(
+            self.events, self.registry,
+            lambda: self.stats.line(len(self.corpus), self._coverage()),
+            every=self.stats_every, print_stats=print_stats)
 
     def minset(self, outputs_dir, print_stats: bool = False) -> Corpus:
         """`--runs=0` mode: replay the seed corpus exactly once — no
@@ -136,31 +245,23 @@ class FuzzLoop:
         (callers prune subsumed stale files with its digest set)."""
         # Corpus handles digest-named persistence + dedup; outputs_dir=None
         # (no outputs configured) counts without writing
+        spans = self.registry.spans
         kept = Corpus(outputs_dir=outputs_dir)
         seeds = list(self.corpus)
         for start in range(0, len(seeds), self.batch_size):
             batch = seeds[start:start + self.batch_size]
-            results = self.backend.run_batch(batch, self.target)
-            for lane, (data, result) in enumerate(zip(batch, results)):
-                self.stats.testcases += 1
-                if isinstance(result, Timedout):
-                    self.stats.timeouts += 1
-                elif isinstance(result, Cr3Change):
-                    self.stats.cr3s += 1
-                elif isinstance(result, OverlayFull):
-                    self.stats.overlay_fulls += 1
-                elif isinstance(result, Crash):
-                    self.stats.crashes += 1
-                    self._save_crash(data, result)
-                if self.backend.lane_found_new_coverage(lane):
-                    self.stats.new_coverage += 1
-                    kept.add(data)
-            self.target.restore()
-            self.backend.restore()
-            now = time.time()
-            if print_stats and now - self.stats.last_print >= self.stats_every:
-                self.stats.last_print = now
-                print(self.stats.line(len(self.corpus), self._coverage()))
+            with spans.span("execute"):
+                results = self.backend.run_batch(batch, self.target)
+            with spans.span("harvest"):
+                for lane, (data, result) in enumerate(zip(batch, results)):
+                    self._account(data, result)
+                    if self.backend.lane_found_new_coverage(lane):
+                        self.stats.new_coverage += 1
+                        kept.add(data)
+            with spans.span("restore"):
+                self.target.restore()
+                self.backend.restore()
+            self._heartbeat(print_stats)
         return kept
 
     def fuzz(self, runs: int, print_stats: bool = False,
@@ -169,10 +270,7 @@ class FuzzLoop:
         --runs=0 to `minset` instead, matching the reference)."""
         while runs == 0 or self.stats.testcases < runs:
             found = self.run_one_batch()
-            now = time.time()
-            if print_stats and now - self.stats.last_print >= self.stats_every:
-                self.stats.last_print = now
-                print(self.stats.line(len(self.corpus), self._coverage()))
+            self._heartbeat(print_stats)
             if stop_on_crash and found:
                 break
         return self.stats

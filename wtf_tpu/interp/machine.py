@@ -37,6 +37,23 @@ from wtf_tpu.core.results import StatusCode
 from wtf_tpu.interp.limbs import pack_u64, unpack_np
 from wtf_tpu.mem.overlay import DirtyOverlay, overlay_init, overlay_reset
 
+# Device-side telemetry counter block: one u32 per lane per counter,
+# accumulated in-graph by step_lane and folded into host metrics once per
+# burst (no per-step host sync).  u32 (not u64 — the TPU has no native
+# 64-bit ints; a u64 counter would re-add the limb ops PR 2 removed)
+# covers every budgeted run: counters reset at each restore and the
+# BASELINE budget is 100M instructions/testcase.  Caveat: with limit=0
+# (unlimited) a single testcase retiring > 2^32 instructions wraps the
+# counter while the u64 icount keeps counting — the CTR_INSTR == icount
+# invariant holds mod 2^32 there.
+CTR_INSTR = 0        # instructions retired (commit) — the oracle fallback
+                     # mirrors its host steps in (runner._fallback_step) so
+                     # this matches icount exactly, fallback paths included
+CTR_MEM_FAULT = 1    # translation faults observed (device page walks +
+                     # oracle MemFaults), counted once per fault event
+CTR_DECODE_MISS = 2  # decode-cache misses (NEED_DECODE transitions)
+N_CTRS = 3
+
 
 class Machine(NamedTuple):
     """All fields carry a leading lane axis."""
@@ -80,6 +97,10 @@ class Machine(NamedTuple):
     bp_skip: jax.Array    # int32[L] suppress bp check for one step post-resume
     fault_gva: jax.Array  # uint64[L] faulting address (PAGE_FAULT/SMC detail)
     fault_write: jax.Array  # int32[L] 1 when the faulting access was a write
+
+    # Device-side telemetry (CTR_* indices above); folded into the host
+    # metrics registry once per burst, reset on restore
+    ctr: jax.Array        # uint32[L, N_CTRS]
 
     # Coverage (reference: robin_set<Gva_t> per run + edge hash inserts,
     # bochscpu_backend.cc:479-548,699-728 — here: per-lane bitmaps)
@@ -189,6 +210,7 @@ def machine_init(
         bp_skip=jnp.zeros((n_lanes,), dtype=jnp.int32),
         fault_gva=jnp.zeros((n_lanes,), dtype=jnp.uint64),
         fault_write=jnp.zeros((n_lanes,), dtype=jnp.int32),
+        ctr=jnp.zeros((n_lanes, N_CTRS), dtype=jnp.uint32),
         cov=jnp.zeros((n_lanes, (uop_capacity + 31) // 32), dtype=jnp.uint32),
         edge=jnp.zeros((n_lanes, (1 << edge_bits) // 32), dtype=jnp.uint32),
         overlay=overlay_init(n_lanes, overlay_slots),
@@ -201,6 +223,7 @@ def _machine_restore_impl(machine: Machine,
         # Keep the overlay *storage* from the live machine so no new buffers
         # are allocated; overlay_reset rebuilds just the indexing state.
         overlay=overlay_reset(machine.overlay),
+        ctr=jnp.zeros_like(machine.ctr),
         cov=jnp.zeros_like(machine.cov),
         edge=jnp.zeros_like(machine.edge),
     )

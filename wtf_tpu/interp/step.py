@@ -2108,6 +2108,12 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 
     # -- bookkeeping -------------------------------------------------------
     new_icount = st.icount + jnp.where(commit, _u(1), _u(0))
+    # device-side telemetry block (machine.CTR_INSTR/MEM_FAULT/DECODE_MISS
+    # order): accumulated in-graph every step, folded into host metrics
+    # once per burst — the per-step host sync this exists to avoid.
+    # page_fault/miss already imply `enabled`, commit implies `live`.
+    new_ctr = st.ctr + jnp.stack(
+        [commit, page_fault, miss]).astype(jnp.uint32)
     timed = commit & (limit > _u(0)) & (new_icount >= limit)
     new_rdrand = jnp.where(commit & is_(U.OPC_RDRAND), rdrand_next, st.rdrand)
     new_bp_skip = jnp.where(commit, jnp.int32(0), st.bp_skip)
@@ -2179,8 +2185,8 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         cs=new_cs, ss=new_ss,
         status=new_status, icount=new_icount, rdrand=new_rdrand,
         bp_skip=new_bp_skip, fault_gva=new_fault_gva,
-        fault_write=new_fault_write, cov=new_cov, edge=new_edge,
-        overlay=overlay)
+        fault_write=new_fault_write, ctr=new_ctr, cov=new_cov,
+        edge=new_edge, overlay=overlay)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,161 @@
+"""Append-only JSONL event log: the machine-readable campaign stream.
+
+The reference's operational record is whatever scrolled past on stdout;
+here every noteworthy campaign event is one JSON object per line in
+`<telemetry-dir>/events.jsonl`, so a run can be replayed, diffed, and
+summarized offline (tools/telemetry_report.py) while the human heartbeat
+line stays exactly what it always was.
+
+Schema (every record):
+  ts    float unix seconds
+  seq   monotonically increasing per-log sequence number
+  type  event type string
+plus per-type payload fields.  The well-known types:
+
+  run-start     campaign start (subcommand, name, backend, argv)
+  heartbeat     periodic: the human status `line` + a full registry
+                `metrics` dump (per-phase span totals ride in here)
+  new-coverage  new coverage entered the corpus — fuzz loop records
+                carry (digest, size); master records carry
+                (new_addresses, total, size)
+  crash         a crash was recorded (name, size, new) — cli run-mode
+                records carry (name, input)
+  timeout       per-batch timeout count (aggregated — a 4096-lane batch
+                of timeouts is one record, not 4096)
+  compile       a chunk executor's first dispatch pays its XLA compile
+                (chunk_steps, donate); the wall shows inside the next
+                device-step span
+  error         operational failure that used to be a bare print()
+                (kind, detail + per-kind fields)
+  run-end       final registry dump at campaign end (metrics)
+
+Call sites hold a sink unconditionally: `NullEventLog` swallows
+everything, so `self.events.emit(...)` never needs a None check on a hot
+path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+
+class NullEventLog:
+    """No-op sink with the full EventLog surface."""
+
+    path = None
+
+    def emit(self, type: str, **fields) -> None:  # noqa: A002
+        pass
+
+    def heartbeat(self, registry=None, line: Optional[str] = None,
+                  **fields) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullEventLog":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+NULL = NullEventLog()
+
+
+class EventLog(NullEventLog):
+    """JSONL sink.  Every record is flushed on write — event rates are
+    heartbeat-scale (not per-testcase), and a crashed run must not lose
+    its tail."""
+
+    def __init__(self, path, clock=time.time):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._clock = clock
+        self._seq = 0
+        self._broken = False
+
+    @classmethod
+    def for_dir(cls, directory) -> "EventLog":
+        """The --telemetry-dir convention: events.jsonl inside it."""
+        return cls(Path(directory) / "events.jsonl")
+
+    def emit(self, type: str, **fields) -> None:  # noqa: A002
+        # Telemetry is an observability side-channel: a full disk or a
+        # yanked --telemetry-dir must degrade it to a no-op, never abort
+        # the campaign it is narrating (the crash-save/coverage-write
+        # paths make the same call).  One warning, then silence.
+        if self._broken:
+            return
+        record = {"ts": self._clock(), "seq": self._seq, "type": type}
+        record.update(fields)
+        self._seq += 1
+        try:
+            self._fh.write(json.dumps(record, default=str) + "\n")
+            self._fh.flush()
+        except OSError as e:
+            self._disable(e)
+
+    def heartbeat(self, registry=None, line: Optional[str] = None,
+                  **fields) -> None:
+        payload = dict(fields)
+        if line is not None:
+            payload["line"] = line
+        if registry is not None:
+            payload["metrics"] = registry.dump()
+        self.emit("heartbeat", **payload)
+
+    def flush(self) -> None:
+        if self._broken:
+            return
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError as e:
+            self._disable(e)
+
+    def _disable(self, e: OSError) -> None:
+        self._broken = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "telemetry write failed (%s); disabling event log %s",
+            e, self.path)
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+
+
+def open_event_log(telemetry_dir) -> NullEventLog:
+    """EventLog for a --telemetry-dir value, NULL for None — the one-line
+    wiring every CLI driver uses."""
+    if telemetry_dir is None:
+        return NULL
+    return EventLog.for_dir(telemetry_dir)
+
+
+def read_events(path):
+    """Yield records from an events.jsonl (skipping any torn final line —
+    a killed run may die mid-write)."""
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue

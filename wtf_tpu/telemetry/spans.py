@@ -1,0 +1,121 @@
+"""Phase spans: where the wall-clock goes, fenced against async dispatch.
+
+JAX dispatch is asynchronous — `machine = run_chunk(...)` returns before
+the TPU finishes, so a naive timer around it measures Python dispatch,
+not device execution, and the "missing" time surfaces in whichever later
+span happens to synchronize first (the classic async-profiling lie; the
+Concordia/Cudagrind phase-accounting papers in PAPERS.md exist because
+of it).  A Span therefore exposes `fence(value)` — an explicit
+`jax.block_until_ready` barrier the caller drops on the device values it
+just produced, so the span's end time is taken AFTER the device work is
+actually done:
+
+    with spans.span("device-step") as sp:
+        machine = run_chunk(tab, image, machine, limit)
+        sp.fence(machine.status)
+
+Spans nest: a span opened inside another records under the joined path
+("execute/device-step"), so a report can both account top-level phases
+against wall-clock (paths without "/") and break a phase down.  Totals
+land in the owning registry as `phase.seconds{path}` / `phase.calls{path}`
+labeled counters — one metric namespace shared with everything else, one
+heartbeat dump carries it all.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from wtf_tpu.telemetry.metrics import Registry
+
+SECONDS = "phase.seconds"
+CALLS = "phase.calls"
+
+
+def block_until_ready(value) -> None:
+    """Fence: wait until every device array in `value` has materialized.
+    No-op for host values and when jax isn't importable (telemetry stays
+    usable from pure-host tools)."""
+    if value is None:
+        return
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax is baked into this image
+        return
+    try:
+        jax.block_until_ready(value)
+    except Exception:
+        pass  # non-pytree host object: already materialized
+
+
+class Span:
+    """One open phase measurement (context-managed via Spans.span)."""
+
+    __slots__ = ("path", "_spans", "_t0")
+
+    def __init__(self, spans: "Spans", path: str):
+        self.path = path
+        self._spans = spans
+        self._t0 = spans._clock()
+
+    def fence(self, value) -> None:
+        """Block until `value`'s device buffers are ready — call on the
+        chunk's outputs before the span closes so async dispatch can't
+        shift its time into a later span."""
+        block_until_ready(value)
+
+    @property
+    def elapsed(self) -> float:
+        return self._spans._clock() - self._t0
+
+
+class Spans:
+    """Registry-owned span timer.  Single-threaded by design (the run
+    loop is); the nesting stack is just a list."""
+
+    def __init__(self, registry: Registry, clock=time.perf_counter):
+        self._registry = registry
+        self._clock = clock
+        self._stack: List[str] = []
+
+    def span(self, name: str) -> "_SpanCtx":
+        """Open a phase span (context manager; call sp.fence(value) inside
+        the with-block on the device values the phase produced)."""
+        return _SpanCtx(self, name)
+
+    def seconds(self, path: str) -> float:
+        """Accumulated seconds recorded under `path` (0.0 if never hit)."""
+        children = self._registry.counter(SECONDS).children
+        child = children.get(path)
+        return child.value if child is not None else 0.0
+
+    def _record(self, path: str, dt: float) -> None:
+        self._registry.counter(SECONDS).labels(path).inc(dt)
+        self._registry.counter(CALLS).labels(path).inc()
+
+
+class _SpanCtx:
+    __slots__ = ("_spans", "_name", "_span")
+
+    def __init__(self, spans: Spans, name: str):
+        self._spans = spans
+        self._name = name
+        self._span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        spans = self._spans
+        path = "/".join(spans._stack + [self._name])
+        spans._stack.append(self._name)
+        self._span = Span(spans, path)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # record even on an in-span exception: a crashed phase's time
+        # is exactly what a post-mortem wants attributed
+        spans = self._spans
+        dt = self._span.elapsed
+        if spans._stack and spans._stack[-1] == self._name:
+            spans._stack.pop()
+        spans._record(self._span.path, dt)
+        return None

@@ -1,7 +1,8 @@
 """Execution-trace writers (reference TraceType_t surface, SURVEY §5.1)."""
 
 from wtf_tpu.trace.writers import (
-    CovTraceWriter, RipTraceWriter, TenetTraceWriter,
+    CovTraceWriter, RipTraceWriter, TenetTraceWriter, TraceWriter,
 )
 
-__all__ = ["CovTraceWriter", "RipTraceWriter", "TenetTraceWriter"]
+__all__ = ["CovTraceWriter", "RipTraceWriter", "TenetTraceWriter",
+           "TraceWriter"]

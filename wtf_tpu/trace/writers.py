@@ -21,20 +21,38 @@ _TENET_REGS = ("rax", "rbx", "rcx", "rdx", "rbp", "rsp", "rsi", "rdi",
                "r8", "r9", "r10", "r11", "r12", "r13", "r14", "r15", "rip")
 
 
-class RipTraceWriter:
+class TraceWriter:
+    """Base: owns the file handle, context-manager lifetime, and explicit
+    flush.  A crashed run's trace is usually the one that matters —
+    `flush()` lets long-running drivers checkpoint buffered lines, and
+    `with` guarantees the tail reaches disk even when the run raises."""
+
     def __init__(self, path):
         self._fh = open(Path(path), "w")
 
+    def flush(self) -> None:
+        if not self._fh.closed:
+            self._fh.flush()
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+class RipTraceWriter(TraceWriter):
     def on_step(self, rip: int) -> None:
         self._fh.write(f"{rip:#x}\n")
 
-    def close(self) -> None:
-        self._fh.close()
 
-
-class CovTraceWriter:
+class CovTraceWriter(TraceWriter):
     def __init__(self, path):
-        self._fh = open(Path(path), "w")
+        super().__init__(path)
         self._seen = set()
 
     def on_step(self, rip: int) -> None:
@@ -42,16 +60,13 @@ class CovTraceWriter:
             self._seen.add(rip)
             self._fh.write(f"{rip:#x}\n")
 
-    def close(self) -> None:
-        self._fh.close()
 
-
-class TenetTraceWriter:
+class TenetTraceWriter(TraceWriter):
     """Register+memory delta lines.  Call on_step AFTER each instruction
     with the post-state registers and that instruction's accesses."""
 
     def __init__(self, path):
-        self._fh = open(Path(path), "w")
+        super().__init__(path)
         self._prev: Optional[Dict[str, int]] = None
 
     def on_step(self, regs: Dict[str, int],
@@ -68,6 +83,3 @@ class TenetTraceWriter:
         if line:
             self._fh.write(line + "\n")
         self._prev = dict(regs)
-
-    def close(self) -> None:
-        self._fh.close()
