@@ -59,6 +59,81 @@ def measure(name, cfg, chunk=512):
     }), flush=True)
 
 
+def fused_ab(n_lanes, limit, chunk, payload):
+    """Fused-vs-XLA A/B core, shared by `ablate.py fused` and
+    `bench.py --fused-compare`: the same warmed demo_tlv batch driven
+    through Runner.run() with fused_step off vs on.  Returns
+    {"off": col, "on": col} with cold wall, warm wall, instr/s, and (on)
+    the kernel occupancy — both occupancy terms come from the device
+    counter block (CTR_INSTR == icount by invariant), so the ratio is
+    exactly retired-in-kernel / retired."""
+    from wtf_tpu.harness import demo_tlv
+    from wtf_tpu.interp.machine import CTR_FUSED, CTR_INSTR
+    from wtf_tpu.interp.runner import Runner, warm_decode_cache
+
+    def insert(r):
+        view = r.view()
+        for lane in range(n_lanes):
+            view.virt_write(lane, demo_tlv.INPUT_GVA, payload)
+            view.r["gpr"][lane, 2] = np.uint64(len(payload))
+        r.push(view)
+
+    cols = {}
+    for mode in ("off", "on"):
+        r = Runner(demo_tlv.build_snapshot(), n_lanes=n_lanes,
+                   chunk_steps=chunk, fused_step=mode)
+        r.limit = limit
+        warm_decode_cache(r, demo_tlv.TARGET, payload)
+        insert(r)
+        t0 = time.time()
+        r.run()                       # cold pass: compiles + decode fill
+        cold_s = time.time() - t0
+        r.restore()
+        insert(r)
+        t0 = time.time()
+        r.run()
+        warm_s = time.time() - t0
+        ctr = r.device_counters()
+        instr = int(ctr[:, CTR_INSTR].sum(dtype=np.uint64))
+        col = {"compile_plus_run_s": round(cold_s, 3),
+               "warm_wall_s": round(warm_s, 4),
+               "instr_per_s": round(instr / warm_s, 1)}
+        if mode == "on":
+            fused = int(ctr[:, CTR_FUSED].sum(dtype=np.uint64))
+            col["fused_occupancy"] = round(fused / max(instr, 1), 4)
+        cols[mode] = col
+    return cols
+
+
+def measure_fused(n_lanes=None, limit=None, chunk=512):
+    """Fused-Pallas-ladder A/B (ISSUE 4), reporting warm wall, instr/s,
+    and the kernel occupancy.  On a real TPU this times the actual Mosaic
+    kernel at campaign scale (1024 lanes); elsewhere the kernel runs
+    under Pallas interpret mode — grid-point-by-grid-point emulation — so
+    the default run scales down to stay minutes-scale, and jax builds
+    without pallas support skip with a reason instead of aborting the
+    remaining default configs."""
+    import jax
+
+    from wtf_tpu.interp.pstep import fused_available
+
+    on_tpu = jax.default_backend() == "tpu"
+    if n_lanes is None:
+        n_lanes = 1024 if on_tpu else 64
+    if limit is None:
+        limit = 20_000 if on_tpu else 5_000
+    report = {"config": "fused", "n_lanes": n_lanes, "limit": limit,
+              "chunk": chunk, "platform": jax.devices()[0].platform}
+    if not fused_available():
+        report["skipped"] = "this jax build cannot run pallas kernels"
+        print(json.dumps(report), flush=True)
+        return
+    cols = fused_ab(n_lanes, limit, chunk, b"\x01\x08AAAAAAAA" * 200)
+    report["fused_off"] = cols["off"]
+    report["fused_on"] = cols["on"]
+    print(json.dumps(report), flush=True)
+
+
 def measure_deep(n_lanes=1024, limit=10_000_000, seconds=30.0):
     """BASELINE-config-3-shaped end-to-end number (the same workload
     bench.py reports in its `deep` extras): mangle campaign on demo_spin
@@ -104,10 +179,12 @@ if __name__ == "__main__":
 
     faulthandler.dump_traceback_later(
         int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
-    names = sys.argv[1:] or list(CONFIGS) + ["deep"]
+    names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused"]
     for n in names:
         if n == "deep":
             measure_deep()
+        elif n == "fused":
+            measure_fused()
         else:
             measure(n, CONFIGS[n])
         faulthandler.cancel_dump_traceback_later()

@@ -145,6 +145,18 @@ def test_mul32_wide_highs():
         assert int(np.asarray(hi)[i]) == p >> 32, f"mulhi {x:#x}*{y:#x}"
 
 
+def test_umulhi_smulhi64():
+    ax, bx = _cross(CORNERS, CORNERS[:14])
+    a, b = _pairs(ax), _pairs(bx)
+    uhi = _ints(L.umulhi64(a, b))
+    shi = _ints(L.smulhi64(a, b))
+    for i, (x, y) in enumerate(zip(ax, bx)):
+        assert uhi[i] == (x * y) >> 64, f"umulhi64 {x:#x}*{y:#x}"
+        sx = x - (1 << 64) if x >> 63 else x
+        sy = y - (1 << 64) if y >> 63 else y
+        assert shi[i] == ((sx * sy) >> 64) & MASK64, f"smulhi64 {x:#x}*{y:#x}"
+
+
 def test_mul64_lo_and_splitmix():
     ax, bx = _cross(CORNERS, CORNERS[:12])
     a, b = _pairs(ax), _pairs(bx)
@@ -306,6 +318,101 @@ def test_hlo_step_alu_path_is_u64_free():
         lambda sub, a, c, n, rf: S.unary_limb(sub, a, c, n, rf),
         jnp.int32(0), p, jnp.bool_(False), jnp.int32(4), jnp.uint32(0x246),
         name="unary_limb")
+
+
+def test_hlo_step_shift_mul_paths_are_u64_free():
+    """ISSUE 4 satellite: the SHIFT/ROT and MUL opclasses are ported onto
+    the limb shift/rotate and widening-multiply helpers — the zero-u64
+    pin extends to them (PERF.md open lever 5)."""
+    p = _u32s(0x55667788, 0x11223344)
+    q = _u32s(0xDEADBEEF, 0x12345678)
+    _assert_no_u64(lambda a, b: L.umulhi64(a, b), p, q, name="umulhi64")
+    _assert_no_u64(lambda a, b: L.smulhi64(a, b), p, q, name="smulhi64")
+    _assert_no_u64(
+        lambda sub, sx, a, fill, cl, sl, il, c, n, rf: S.shift_limb(
+            sub, sx, a, fill, cl, sl, il, c, n, rf),
+        jnp.int32(4), jnp.int32(0), p, q, jnp.uint32(7), jnp.uint32(3),
+        jnp.uint32(2), jnp.bool_(True), jnp.int32(8), jnp.uint32(0x246),
+        name="shift_limb")
+    _assert_no_u64(
+        lambda sub, sx, a, b, rax, imm, n, rf: S.mul_limb(
+            sub, sx, a, b, rax, imm, n, rf),
+        jnp.int32(2), jnp.int32(0), p, q, p, q, jnp.int32(8),
+        jnp.uint32(0x246), name="mul_limb")
+
+
+def test_limb_shift_mul_match_bigint_reference():
+    """shift_limb / mul_limb against Python big-int recomputation of the
+    x86 semantics at every width — the contract the deleted u64 SHIFT/MUL
+    blocks embodied (results only; the flag images are pinned three-way by
+    tests/test_step.py's hardware-differential corpus)."""
+    from wtf_tpu.cpu import uops as U
+
+    rng = np.random.default_rng(0x5417)
+    k = 128
+    a64 = rng.integers(0, 1 << 64, k, dtype=np.uint64)
+    f64 = rng.integers(0, 1 << 64, k, dtype=np.uint64)
+    cnt = rng.integers(0, 256, k, dtype=np.uint64)
+    for nbytes in (1, 2, 4, 8):
+        bits = nbytes * 8
+        m = (1 << bits) - 1
+        n = jnp.full(k, nbytes, dtype=jnp.int32)
+        a = L.zext(L.pair(jnp.asarray(a64)), n)
+        fill = L.zext(L.pair(jnp.asarray(f64)), n)
+        cl = jnp.asarray(cnt, dtype=np.uint32)
+
+        def run_shift(subval, sextv=0):
+            r, _rf, writes = S.shift_limb(
+                jnp.full(k, subval, jnp.int32), jnp.full(k, sextv, jnp.int32),
+                a, fill, cl, cl, cl, jnp.full(k, True), n,
+                jnp.uint32(0x246))
+            return _ints(r), np.asarray(writes)
+
+        cmask = 0x3F if nbytes == 8 else 0x1F
+        got_shl, w_shl = run_shift(U.SH_SHL)
+        got_shr, _ = run_shift(U.SH_SHR)
+        got_sar, _ = run_shift(U.SH_SAR)
+        got_rol, _ = run_shift(U.SH_ROL)
+        got_rcl, _ = run_shift(U.SH_RCL)
+        for i in range(k):
+            av = int(a64[i]) & m
+            c = int(cnt[i]) & cmask
+            if c == 0:
+                assert not w_shl[i]
+                continue
+            assert got_shl[i] == (av << c) & m if c < 64 else 0
+            assert got_shr[i] == (av >> c) if c < 64 else 0
+            sv = av - (1 << bits) if av >> (bits - 1) else av
+            assert got_sar[i] == (sv >> min(c, 63)) & m
+            rc = c % bits
+            want_rol = av if rc == 0 else ((av << rc) | (av >> (bits - rc))) & m
+            assert got_rol[i] == want_rol, f"rol n={nbytes} a={av:#x} c={c}"
+            crc = c % (bits + 1)
+            wide = (1 << bits) | av          # CF=1 : bits+1-bit value
+            want_rcl = av if crc == 0 else (
+                ((wide << crc) | (wide >> (bits + 1 - crc))) & m)
+            assert got_rcl[i] == want_rcl, f"rcl n={nbytes} a={av:#x} c={c}"
+
+        b = L.zext(L.pair(jnp.asarray(f64)), n)
+        for subval, signed in ((U.MUL_WIDE_U, False), (U.MUL_WIDE_S, True),
+                               (U.MUL_2OP, True)):
+            r1, r2, _rf = S.mul_limb(
+                jnp.full(k, subval, jnp.int32), jnp.zeros(k, jnp.int32),
+                a, b, a, b, n, jnp.uint32(0x246))
+            g1, g2 = _ints(r1), _ints(r2)
+            for i in range(k):
+                av, bv = int(a64[i]) & m, int(f64[i]) & m
+                sa = av - (1 << bits) if signed and av >> (bits - 1) else av
+                sb = bv - (1 << bits) if signed and bv >> (bits - 1) else bv
+                prod = sa * sb
+                if subval == U.MUL_2OP:
+                    assert g1[i] == prod & m, f"imul2 n={nbytes}"
+                elif nbytes == 1:
+                    assert g1[i] == prod & 0xFFFF, f"mul8 {av:#x}*{bv:#x}"
+                else:
+                    assert g1[i] == prod & m
+                    assert g2[i] == (prod >> bits) & m, (
+                        f"mulhi n={nbytes} {av:#x}*{bv:#x} sub={subval}")
 
 
 def test_hlo_step_addressing_path_is_u64_free():

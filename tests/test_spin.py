@@ -161,3 +161,62 @@ def test_one_oracle_lane_does_not_stall_the_ladder():
     # bits (_pending_edge) — lane 0 ran through the same control flow
     assert np.array_equal(np.asarray(runner.machine.edge)[0],
                           np.asarray(slow.machine.edge)[0])
+
+
+def test_burst_any_tier_cpu_override_not_forced():
+    """ISSUE 4 satellite (VERDICT weak item 4): the any-instruction burst
+    tier is a CONSTRUCTOR config now, not a hard-wired platform check —
+    `Runner(..., burst_any_tier=True)` enables it on the CPU platform
+    without poking runner attributes, and the override rides the backend
+    kwargs path (`create_backend("tpu", ..., burst_any_tier=...)`).
+    With the tier on, a chronic oracle lane runs ahead THROUGH device-class
+    glue between its fxsave ops (more burst steps, fewer chunk dispatches);
+    both ways execute bit-identically."""
+    from tests.emurunner import DATA_BASE, build_guest
+    from wtf_tpu.core.results import StatusCode
+    from wtf_tpu.interp.runner import Runner
+    from wtf_tpu.snapshot.loader import Snapshot
+
+    asm = f"""
+        mov rbx, {DATA_BASE}
+        mov ecx, 12
+    lp:
+        fxsave [rbx+0x200]
+        inc rax
+        inc rdx
+        fxsave [rbx+0x400]
+        dec ecx
+        jnz lp
+        int3
+    """
+    data = {DATA_BASE: bytes(0x1000)}
+
+    def run_with(tier):
+        physmem, cpu, _ = build_guest(asm, data)
+        runner = Runner(Snapshot(physmem=physmem, cpu=cpu), n_lanes=2,
+                        chunk_steps=64, burst_any_tier=tier)
+        assert runner.burst_any_tier is tier  # not the cpu-platform default
+        status = runner.run()
+        assert all(StatusCode(int(s)) == StatusCode.CRASH for s in status)
+        return runner
+
+    on = run_with(True)
+    off = run_with(False)
+    # identical execution either way
+    assert np.array_equal(np.asarray(on.machine.gpr),
+                          np.asarray(off.machine.gpr))
+    assert np.array_equal(np.asarray(on.machine.icount),
+                          np.asarray(off.machine.icount))
+    assert np.array_equal(np.asarray(on.machine.cov),
+                          np.asarray(off.machine.cov))
+    # the tier actually engaged: the chronic lane ran ahead through the
+    # inc/inc/dec/jnz glue on the oracle instead of bouncing back to the
+    # device at every one
+    assert (on.stats["fallback_burst_steps"]
+            > off.stats["fallback_burst_steps"]), (
+        on.stats["fallback_burst_steps"], off.stats["fallback_burst_steps"])
+    assert on.stats["chunks"] < off.stats["chunks"]
+
+    # the backend kwargs path carries the override too
+    backend = make_backend("tpu", n_lanes=2, burst_any_tier=True)
+    assert backend.runner.burst_any_tier is True

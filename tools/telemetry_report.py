@@ -115,6 +115,22 @@ def summarize(path) -> dict:
             "instructions": metrics.get("device.instructions", 0),
             "mem_faults": metrics.get("device.mem_faults", 0),
             "decode_misses": metrics.get("device.decode_misses", 0),
+            "fused_steps": metrics.get("device.fused_steps", 0),
+            # fraction of retired instructions executed inside the fused
+            # Pallas kernel (interp/pstep.py); null when the fast path
+            # never ran, so 0% occupancy can't be confused with "off".
+            # "ran" is detected by the pallas-step span, not the counter:
+            # a fused campaign whose every lane parks each round must
+            # read as the actionable 0.0, not as null
+            "fused_occupancy": (
+                round(metrics.get("device.fused_steps", 0)
+                      / metrics["device.instructions"], 4)
+                if metrics.get("device.instructions")
+                and (metrics.get("device.fused_steps", 0) > 0
+                     or any(path.split("/")[-1] == "pallas-step"
+                            for path in (metrics.get("phase.seconds")
+                                         or {})))
+                else None),
         },
         "errors": errors,
     }
@@ -153,9 +169,12 @@ def _print_human(s: dict) -> None:
         for opclass, rate in s["fallback_rate_per_opclass"].items():
             print(f"  {opclass:<12} {rate}")
     dev = s["device"]
+    fused = (f" fused_steps={dev['fused_steps']}"
+             f" (occupancy {dev['fused_occupancy'] * 100:.1f}%)"
+             if dev.get("fused_occupancy") is not None else "")
     print(f"device counters: instructions={dev['instructions']} "
           f"mem_faults={dev['mem_faults']} "
-          f"decode_misses={dev['decode_misses']}")
+          f"decode_misses={dev['decode_misses']}{fused}")
     for err in s["errors"]:
         print(f"error: {err['kind']}: {err['detail']}")
 

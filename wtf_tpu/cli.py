@@ -61,6 +61,31 @@ def _add_target_selection(p: argparse.ArgumentParser) -> None:
                         "registration")
 
 
+def _add_backend_tuning(p: argparse.ArgumentParser) -> None:
+    """Execution-engine knobs of the tpu backend (ignored by emu)."""
+    p.add_argument("--fused-step", choices=("off", "auto", "on"),
+                   default="off",
+                   help="fused Pallas fast path (interp/pstep.py): one "
+                        "kernel per chunk for the hot integer core, with "
+                        "parked lanes resuming on the XLA step.  auto = "
+                        "on only where the per-kernel dispatch win exists "
+                        "(a real TPU backend)")
+    p.add_argument("--burst-any-tier", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="the oracle burst's any-instruction tier for "
+                        "chronically diverting lanes.  auto = platform "
+                        "default (on off-CPU); on/off force it, e.g. to "
+                        "run or bench the tier on the CPU platform")
+
+
+def _backend_tuning_kwargs(args) -> dict:
+    kwargs = {"fused_step": getattr(args, "fused_step", "off")}
+    tier = getattr(args, "burst_any_tier", "auto")
+    if tier != "auto":
+        kwargs["burst_any_tier"] = tier == "on"
+    return kwargs
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="wtf_tpu",
@@ -84,6 +109,7 @@ def build_parser() -> argparse.ArgumentParser:
                      help="dir of .cov files (IDA/Binja/Ghidra exports); "
                           "prints covered/total per run set")
     run.add_argument("--lanes", type=int, default=4)
+    _add_backend_tuning(run)
 
     fuzz = sub.add_parser("fuzz", help="fuzz node (dials the master)")
     _add_target_selection(fuzz)
@@ -97,6 +123,7 @@ def build_parser() -> argparse.ArgumentParser:
                       help="one multiplexed master connection for the whole"
                            " lane batch instead of one per lane (scales a"
                            " wide node past the master's fd budget)")
+    _add_backend_tuning(fuzz)
 
     master = sub.add_parser("master", help="master node (serves testcases)")
     _add_target_selection(master)
@@ -135,6 +162,7 @@ def build_parser() -> argparse.ArgumentParser:
                            " multi-host launch (host:port)")
     camp.add_argument("--num-processes", type=int, default=None)
     camp.add_argument("--process-id", type=int, default=None)
+    _add_backend_tuning(camp)
     return parser
 
 
@@ -174,7 +202,8 @@ def _telemetry_for(args):
 
 
 def _build_backend(target, backend_name: str, paths: TargetPaths,
-                   limit: int, lanes: int, registry=None, events=None):
+                   limit: int, lanes: int, registry=None, events=None,
+                   tuning: Optional[dict] = None):
     from wtf_tpu.backend import create_backend
     from wtf_tpu.snapshot.loader import load_snapshot
 
@@ -188,7 +217,10 @@ def _build_backend(target, backend_name: str, paths: TargetPaths,
             raise SystemExit(
                 f"target {target.name!r} has no snapshot factory and no "
                 f"--state dir was given")
-    kwargs = {"n_lanes": lanes} if backend_name == "tpu" else {}
+    # engine tuning (--fused-step/--burst-any-tier) applies to the batched
+    # tpu backend only; the oracle backend has no runner underneath
+    kwargs = ({"n_lanes": lanes, **(tuning or {})}
+              if backend_name == "tpu" else {})
     backend = create_backend(backend_name, snapshot, limit=limit,
                              registry=registry, events=events, **kwargs)
     with registry.spans.span("init"):
@@ -221,7 +253,8 @@ def cmd_run(args) -> int:
     with _telemetry_for(args) as (registry, events):
         backend = _build_backend(target, opts.backend, opts.paths,
                                  opts.limit, opts.lanes,
-                                 registry=registry, events=events)
+                                 registry=registry, events=events,
+                                 tuning=_backend_tuning_kwargs(args))
         target.init(backend)
 
         inputs: List[Path] = (
@@ -268,7 +301,8 @@ def cmd_fuzz(args) -> int:
     with _telemetry_for(args) as (registry, events):
         backend = _build_backend(target, opts.backend, opts.paths,
                                  opts.limit, opts.lanes,
-                                 registry=registry, events=events)
+                                 registry=registry, events=events,
+                                 tuning=_backend_tuning_kwargs(args))
         if opts.backend == "tpu":
             node = BatchClient(backend, target, opts.address, mux=args.mux,
                                registry=registry, events=events,
@@ -333,7 +367,8 @@ def cmd_campaign(args) -> int:
     with _telemetry_for(args) as (registry, events):
         backend = _build_backend(target, opts.backend, opts.paths,
                                  opts.limit, opts.lanes,
-                                 registry=registry, events=events)
+                                 registry=registry, events=events,
+                                 tuning=_backend_tuning_kwargs(args))
         target.init(backend)
         rng = random.Random(opts.seed or None)
         # minset (--runs=0) fills its corpus from ONE merged scan below

@@ -26,6 +26,11 @@ inside genuine `gle64` code and surfaces as an access violation.
 Both engines run the same image; the decode census (README table) says
 0.02% of this DLL's .text is undecodable, and the device step executes
 its SSE/SSE2 floating point natively.
+
+Limitation: the CRT math imports sin/cos/atan2/acos are zero-returning
+stubs, so exports whose control flow branches on transcendental results
+explore a distorted input space (every such call sees 0.0) — pick
+exports that don't, or supply real implementations, when that matters.
 """
 
 from __future__ import annotations

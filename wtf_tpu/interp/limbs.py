@@ -127,6 +127,16 @@ def select64(conds, pairs, default):
     return lo, hi
 
 
+def sel(conds, vals, default):
+    """jnp.select semantics over scalar (non-pair) values as a where-fold —
+    same rationale as select64: jnp.select's lowering runs its case index
+    in 64-bit scalars under x64, which the ported paths must not emit."""
+    out = default
+    for c, v in zip(reversed(conds), reversed(vals)):
+        out = jnp.where(c, v, out)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # add/sub with carry/borrow
 # ---------------------------------------------------------------------------
@@ -318,13 +328,45 @@ def mul64_lo(a, b):
     return lo, hi
 
 
+def umulhi64(a, b):
+    """High 64 bits of the unsigned 128-bit product a * b, from four
+    mul32_wide partial products (the step's widening-MUL port and the
+    only place the full 128-bit product shape exists in limb form)."""
+    p00l, p00h = mul32_wide(a[0], b[0])
+    p01l, p01h = mul32_wide(a[0], b[1])
+    p10l, p10h = mul32_wide(a[1], b[0])
+    p11 = mul32_wide(a[1], b[1])
+    # bits 32..63 of the product: p00h + p01l + p10l, carry count 0..2
+    s1 = p00h + p01l
+    c1 = s1 < p01l
+    s2 = s1 + p10l
+    c2 = s2 < p10l
+    midcarry = jnp.where(c1, _u32(1), _u32(0)) + jnp.where(c2, _u32(1), _u32(0))
+    hi = add64(p11, (p01h, _u32(0)))
+    hi = add64(hi, (p10h, _u32(0)))
+    return add64(hi, (midcarry, _u32(0)))
+
+
+def smulhi64(a, b):
+    """High 64 bits of the signed 128-bit product (two's-complement
+    correction of umulhi64, mirroring step.py's deleted _smulhi)."""
+    hi = umulhi64(a, b)
+    zero = (_u32(0), _u32(0))
+    hi = sub64(hi, where64((a[1] >> 31) != 0, b, zero))
+    return sub64(hi, where64((b[1] >> 31) != 0, a, zero))
+
+
 # ---------------------------------------------------------------------------
 # splitmix64 (decode-cache hash probe; must match utils.hashing bit-for-bit)
 # ---------------------------------------------------------------------------
 
-_GOLDEN = const_pair(0x9E3779B97F4A7C15)
-_MIX1 = const_pair(0xBF58476D1CE4E5B9)
-_MIX2 = const_pair(0x94D049BB133111EB)
+# plain-int limb pairs, NOT jnp arrays: a device array created at import
+# time would be a captured constant inside a Pallas kernel trace
+# (interp/pstep.py), which pallas_call rejects; python ints weak-type
+# against the u32 operands and lower to u32 literals either way
+_GOLDEN = (0x9E3779B97F4A7C15 & U32_MASK, 0x9E3779B97F4A7C15 >> 32)
+_MIX1 = (0xBF58476D1CE4E5B9 & U32_MASK, 0xBF58476D1CE4E5B9 >> 32)
+_MIX2 = (0x94D049BB133111EB & U32_MASK, 0x94D049BB133111EB >> 32)
 
 
 def mix64(z):

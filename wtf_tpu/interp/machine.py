@@ -52,7 +52,11 @@ CTR_INSTR = 0        # instructions retired (commit) — the oracle fallback
 CTR_MEM_FAULT = 1    # translation faults observed (device page walks +
                      # oracle MemFaults), counted once per fault event
 CTR_DECODE_MISS = 2  # decode-cache misses (NEED_DECODE transitions)
-N_CTRS = 3
+CTR_FUSED = 3        # instructions retired INSIDE the fused Pallas step
+                     # kernel (interp/pstep.py); a subset of CTR_INSTR, so
+                     # fused occupancy = CTR_FUSED / CTR_INSTR.  Stays 0
+                     # on the plain XLA chunk path
+N_CTRS = 4
 
 
 class Machine(NamedTuple):

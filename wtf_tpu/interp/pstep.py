@@ -1,0 +1,496 @@
+"""Pallas fused-step fast path: K interpreter steps in ONE kernel dispatch.
+
+PERF.md's performance model says the batched step's wall-clock on real TPUs
+is proportional to the NUMBER of unfusable gather/scatter kernels XLA emits
+(decode probe, uop fetch, page walks, window reads, coverage scatters —
+~13 per step), not to FLOPs.  This module is open lever 3: the hot integer
+core of the interpreter runs as one Pallas kernel that advances every lane
+up to K instructions per dispatch, so a hot stretch costs ONE kernel launch
+instead of ~13 per instruction.  It is the de-risking prototype for the
+fully fused interpreter — the persistent-kernel shape Concordia uses to keep
+inference inside one long-lived device kernel, and the Linear-Algebraic
+Hypervisor's "interpretation belongs inside the accelerator's execution
+model" argument, landed as shippable code.
+
+Hot subset (everything the u32-limb library already covers, PR 2):
+  decode-cache hash probe, uop fetch, breakpoint/bp_skip gate, dirty-code
+  check, register/immediate MOV (incl. movzx/movsx), LEA, the integer ALU
+  and UNARY classes with their flag images, SETCC/CMOVCC, condition
+  evaluation, Jcc/JMP/fallthrough rip updates, coverage + edge-hash bits,
+  the icount/limit (TIMEDOUT) bookkeeping, and the device counter block.
+
+Anything else — memory-operand forms, stack ops, shifts/mul/div, strings,
+SSE/x87, system instructions, an armed breakpoint, or code bytes that are
+overlay-dirty or diverge from the decode-time raw bytes — PARKS the lane
+BEFORE executing: state is untouched and status becomes NEEDS_XLA.  The
+runner's chunk ladder (interp/runner.py) then resumes parked lanes with a
+short XLA chunk and re-enters the kernel, so the fused path is a pure fast
+path layered UNDER the existing executor: every instruction retires through
+exactly one of the two engines and the final state is bit-exact vs the
+XLA-only ladder (tests/test_pstep.py pins this differentially, including
+the park-and-resume seam).
+
+Authoring notes (TPU target, validated via interpret=True on CPU):
+  * all arithmetic is u32 limb math (interp/limbs.py) — Pallas TPU kernels
+    cannot hold 64-bit integers, which is exactly why PR 2 packed the hot
+    state; every u64-typed machine leaf crosses into the kernel through a
+    free bitcast at the wrapper seam
+  * the grid iterates lanes; per-lane work is scalar (dynamic-index loads
+    from the uop table / image implement the gather emulation the XLA path
+    pays per-step dispatches for), with the K-step fori_loop carrying the
+    register file as a value
+  * tier-1 runs the kernel under `interpret=True` on the CPU platform —
+    the Mosaic lowering is exercised only when a real TPU backend is
+    attached (`interpret=None` auto-detects)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from wtf_tpu.core.results import StatusCode
+from wtf_tpu.cpu import uops as U
+from wtf_tpu.interp import limbs as L
+from wtf_tpu.interp import step as S
+from wtf_tpu.interp.machine import (
+    CTR_DECODE_MISS, CTR_FUSED, CTR_INSTR, Machine, N_CTRS,
+)
+from wtf_tpu.interp.uoptable import (
+    F_A32, F_BASE_REG, F_COND, F_DST_KIND, F_DST_REG, F_IDX_REG, F_LENGTH,
+    F_OPC, F_OPSIZE, F_SCALE, F_SEG, F_SEXT, F_SRCSIZE, F_SRC_KIND,
+    F_SRC_REG, F_SUB, M_BP, M_PFN0, M_PFN1, PROBES, UopTable,
+)
+from wtf_tpu.mem.physmem import MemImage, PAGE_WORDS
+
+_RUNNING = int(StatusCode.RUNNING)
+_NEED_DECODE = int(StatusCode.NEED_DECODE)
+_NEEDS_XLA = int(StatusCode.NEEDS_XLA)
+_TIMEDOUT = int(StatusCode.TIMEDOUT)
+
+# memoized jitted entry points, keyed (k_steps, interpret) /
+# (n_steps, donate); jit itself re-specializes per array shapes
+_FUSED_CACHE: dict = {}
+_RESUME_CACHE: dict = {}
+
+
+def _u32(x) -> jnp.ndarray:
+    return jnp.uint32(x)
+
+
+def fused_available(interpret: bool = True) -> bool:
+    """Whether this jax build can run the fused kernel (tier-1's
+    skip-with-reason guard: some jax builds ship without pallas interpret
+    support).  Cached after the first probe."""
+    global _FUSED_OK
+    try:
+        return _FUSED_OK
+    except NameError:
+        pass
+    try:
+        from jax.experimental import pallas as pl
+
+        def probe(i_ref, o_ref):
+            o_ref[0] = i_ref[0] + jnp.uint32(1)
+
+        out = pl.pallas_call(
+            probe,
+            out_shape=jax.ShapeDtypeStruct((1,), jnp.uint32),
+            interpret=interpret,
+        )(jnp.zeros(1, jnp.uint32))
+        _FUSED_OK = int(out[0]) == 1
+    except Exception:  # noqa: BLE001 - any failure means "not available"
+        _FUSED_OK = False
+    return _FUSED_OK
+
+
+def _build_kernel(k_steps: int, n_fields: int, hash_size: int,
+                  nframes: int, ebits: int):
+    """The kernel body, specialized on the static table geometry."""
+    hmask = hash_size - 1
+
+    def kernel(hash_ref, trip_ref, tmeta_ref, tmu_ref, pages_ref, ftab_ref,
+               ovpfn_ref, limit_ref,
+               gpr_in, rip_in, rf_in, st_in, ic_in, bp_in, ctr_in, cov_in,
+               edge_in,
+               gpr_out, rip_out, rf_out, st_out, ic_out, bp_out, ctr_out,
+               cov_out, edge_out):
+        # coverage/edge bitmaps copy through, then take in-loop RMW bits
+        cov_out[...] = cov_in[...]
+        edge_out[...] = edge_in[...]
+        ov_row = ovpfn_ref[0]                       # [slots] i32, read once
+        limit_l = (limit_ref[0], limit_ref[1])
+        limit_on = (limit_ref[0] | limit_ref[1]) != _u32(0)
+        z = _u32(0)
+        zero2 = (z, z)
+
+        def probe(rip_l):
+            """uop_lookup's open-addressed probe, one slot at a time (the
+            scalar gather emulation of the XLA path's 8-slot gather pair;
+            first live match wins, same result by insertion uniqueness)."""
+            h_lo, _ = L.splitmix64(rip_l)
+
+            def body(k, found):
+                slot = ((h_lo + _u32(0) + k.astype(jnp.uint32))
+                        & _u32(hmask)).astype(jnp.int32)
+                e = hash_ref[slot]
+                ec = jnp.maximum(e, 0)
+                ok = ((e >= 0) & (trip_ref[ec, 0] == rip_l[0])
+                      & (trip_ref[ec, 1] == rip_l[1]))
+                return jnp.where((found < 0) & ok, e, found)
+
+            return lax.fori_loop(0, PROBES, body, jnp.int32(-1))
+
+        def slot_of(pfn):
+            """frame_slot: pfn -> image page slot (0 = absent/zero page)."""
+            in_range = (pfn >= 0) & (pfn < nframes)
+            safe = jnp.clip(pfn, 0, nframes - 1)
+            return jnp.where(in_range, ftab_ref[safe], 0)
+
+        def step_body(_, carry):
+            gl, rip_l, rf_lo, status, ic_l, bpskip, d_instr, d_miss = carry
+            run = status == jnp.int32(_RUNNING)
+
+            # -- 1. decode-cache probe (identical to step.uop_lookup) ----
+            idx = probe(rip_l)
+            miss = run & (idx < 0)
+            idxc = jnp.maximum(idx, 0)
+            f = tmeta_ref[idxc]                     # [NF+3] i32 row
+            mu = tmu_ref[idxc]                      # [8] u32 row
+            opc = f[F_OPC]
+            sub = f[F_SUB]
+            cond = f[F_COND]
+            length = f[F_LENGTH]
+            opsize = f[F_OPSIZE]
+            srcsize0 = f[F_SRCSIZE]
+            sext_f = f[F_SEXT]
+            dk, dr = f[F_DST_KIND], f[F_DST_REG]
+            sk, sr = f[F_SRC_KIND], f[F_SRC_REG]
+            disp_l = (mu[0], mu[1])
+            imm_l = (mu[2], mu[3])
+            raw_lo_l = (mu[4], mu[5])
+            raw_hi_l = (mu[6], mu[7])
+
+            # -- 2. breakpoint gate (honoring bp_skip, like step_lane) ---
+            at_bp = run & ~miss & (f[M_BP] == 1) & (bpskip == 0)
+
+            # -- 3. hot-subset eligibility: operands must be registers or
+            # immediates; LEA additionally needs no segment base (fs/gs
+            # live outside the kernel).  Everything else parks.
+            reg_dst = dk == U.K_REG
+            src_ri = (sk == U.K_REG) | (sk == U.K_IMM)
+            hot_class = (
+                (opc == U.OPC_NOP) | (opc == U.OPC_FENCE)
+                | ((opc == U.OPC_MOV) & reg_dst & src_ri)
+                | ((opc == U.OPC_LEA) & (f[F_SEG] == 0))
+                | ((opc == U.OPC_ALU) & reg_dst & src_ri)
+                | ((opc == U.OPC_UNARY) & reg_dst)
+                | ((opc == U.OPC_SETCC) & reg_dst)
+                | ((opc == U.OPC_CMOVCC) & (sk != U.K_MEM))
+                | (opc == U.OPC_JCC)
+                | ((opc == U.OPC_JMP) & src_ri))
+
+            # -- 4. dirty/diverged code check.  The XLA step compares live
+            # code bytes THROUGH the overlay; the kernel reads the base
+            # image and parks any lane whose code page frames appear in
+            # its overlay, so a clean compare here is exactly the XLA
+            # verdict and a dirty page falls through to the full check.
+            pfn0, pfn1 = f[M_PFN0], f[M_PFN1]
+            code_dirty = jnp.any((ov_row == pfn0) | (ov_row == pfn1))
+            code_off = (rip_l[0] & _u32(0xFFF)).astype(jnp.int32)
+            crosses = (code_off + 16) > 4096
+            s_first = slot_of(pfn0)
+            s_last = jnp.where(crosses, slot_of(pfn1), s_first)
+            w0 = code_off >> 3
+            words = []
+            for j in range(3):
+                on_first = (w0 + j) < PAGE_WORDS
+                widx = jnp.where(on_first, w0 + j, w0 + j - PAGE_WORDS)
+                slot = jnp.where(on_first, s_first, s_last)
+                words.append((pages_ref[slot, 2 * widx],
+                              pages_ref[slot, 2 * widx + 1]))
+            sh = (rip_l[0] & _u32(7)) * _u32(8)
+            inv = _u32(64) - sh
+            code_lo = L.or64(L.shr64(words[0], sh), L.shl64(words[1], inv))
+            code_hi = L.or64(L.shr64(words[1], sh), L.shl64(words[2], inv))
+            lm_lo = L.size_mask(jnp.minimum(length, 8))
+            lm_hi = L.size_mask(jnp.maximum(length - 8, 0))
+            smc_risk = (code_dirty
+                        | ~L.is_zero64(
+                            L.and64(L.xor64(code_lo, raw_lo_l), lm_lo))
+                        | ~L.is_zero64(
+                            L.and64(L.xor64(code_hi, raw_hi_l), lm_hi)))
+
+            park = run & ~miss & (at_bp | ~hot_class | smc_risk)
+            commit = run & ~miss & ~park
+
+            # -- 5. execute (ported paths of step_lane, scalar per lane) -
+            next_rip_l = L.add64_u32(rip_l, length.astype(jnp.uint32))
+            base_val_l = L.where64(f[F_BASE_REG] == U.REG_RIP, next_rip_l,
+                                   S._read64_l(gl, f[F_BASE_REG]))
+            idx_val_l = S._scale_idx_l(S._read64_l(gl, f[F_IDX_REG]),
+                                       f[F_SCALE])
+            ea_l = S.ea_limb(disp_l, base_val_l, idx_val_l, zero2, f[F_A32])
+            srcsize = jnp.where(srcsize0 == 0, opsize, srcsize0)
+            src_raw_l = L.where64(sk == U.K_REG,
+                                  S._read_reg_l(gl, sr, srcsize), zero2)
+            src_ext_l = L.where64(
+                sext_f == 1, L.zext(L.sext(src_raw_l, srcsize), opsize),
+                L.zext(src_raw_l, opsize))
+            src_val_l = L.where64(sk == U.K_IMM, L.zext(imm_l, opsize),
+                                  src_ext_l)
+            dst_val_l = L.where64(dk == U.K_REG,
+                                  S._read_reg_l(gl, dr, opsize), zero2)
+            cf_in = (rf_lo & _u32(L.CF)) != z
+            alu_r, alu_rf_lo, alu_writes = S.alu_limb(
+                sub, dst_val_l, src_val_l, cf_in, opsize, rf_lo)
+            un_r, un_rf_lo = S.unary_limb(sub, dst_val_l, cf_in, opsize,
+                                          rf_lo)
+            rcx_l = (gl[1, 0], gl[1, 1])
+            cc = L.eval_cond(rf_lo, rcx_l, cond)
+            cc01 = (jnp.where(cc, _u32(1), z), z)
+            jcc_t = L.add64(next_rip_l, imm_l)
+            jmp_t = L.where64(sk == U.K_IMM, jcc_t, src_val_l)
+
+            is_mov = opc == U.OPC_MOV
+            is_lea = opc == U.OPC_LEA
+            is_alu = opc == U.OPC_ALU
+            is_unary = opc == U.OPC_UNARY
+            is_setcc = opc == U.OPC_SETCC
+            is_cmov = opc == U.OPC_CMOVCC
+            is_jcc = opc == U.OPC_JCC
+            is_jmp = opc == U.OPC_JMP
+            w1_cond = L.sel(
+                [is_mov, is_lea, is_alu, is_unary, is_setcc, is_cmov],
+                [jnp.bool_(True), jnp.bool_(True), alu_writes,
+                 jnp.bool_(True), jnp.bool_(True), jnp.bool_(True)],
+                jnp.bool_(False))
+            w1_val = L.select64(
+                [is_mov, is_lea, is_alu, is_unary, is_setcc, is_cmov],
+                [src_val_l, ea_l, alu_r, un_r, cc01,
+                 L.where64(cc, src_val_l, dst_val_l)], zero2)
+            gl_new = S._gpr_write_l(gl, commit & w1_cond, dr, w1_val,
+                                    opsize)
+
+            rf_exec_lo = jnp.where(is_alu, alu_rf_lo,
+                                   jnp.where(is_unary, un_rf_lo, rf_lo))
+            new_rf_lo = jnp.where(commit, rf_exec_lo | _u32(0x2), rf_lo)
+
+            rip_exec = L.select64(
+                [is_jmp, is_jcc],
+                [jmp_t, L.where64(cc, jcc_t, next_rip_l)], next_rip_l)
+            new_rip = L.where64(commit, rip_exec, rip_l)
+
+            # -- 6. bookkeeping: icount/limit, counters, coverage, edges -
+            new_ic = L.where64(commit, L.add64_u32(ic_l, _u32(1)), ic_l)
+            timed = commit & limit_on & ~L.ltu64(new_ic, limit_l)
+            new_bpskip = jnp.where(commit, jnp.int32(0), bpskip)
+            new_status = jnp.where(
+                miss, jnp.int32(_NEED_DECODE),
+                jnp.where(park, jnp.int32(_NEEDS_XLA),
+                          jnp.where(timed, jnp.int32(_TIMEDOUT), status)))
+
+            wi = idxc >> 5
+            cov_bit = jnp.where(
+                commit, _u32(1) << (idxc & 31).astype(jnp.uint32), z)
+            cov_out[0, wi] = cov_out[0, wi] | cov_bit
+            eh_lo = L.mix64(rip_l)[0] ^ rip_exec[0]
+            ei = (eh_lo & _u32(ebits - 1)).astype(jnp.int32)
+            edge_bit = jnp.where(
+                commit & (is_jmp | is_jcc),
+                _u32(1) << (ei & 31).astype(jnp.uint32), z)
+            edge_out[0, ei >> 5] = edge_out[0, ei >> 5] | edge_bit
+
+            one = jnp.where(commit, _u32(1), z)
+            return (gl_new, new_rip, new_rf_lo, new_status, new_ic,
+                    new_bpskip, d_instr + one,
+                    d_miss + jnp.where(miss, _u32(1), z))
+
+        init = (gpr_in[0], (rip_in[0, 0], rip_in[0, 1]), rf_in[0, 0],
+                st_in[0], (ic_in[0, 0], ic_in[0, 1]), bp_in[0],
+                _u32(0), _u32(0))
+        (gl, rip_l, rf_lo, status, ic_l, bpskip, d_instr,
+         d_miss) = lax.fori_loop(0, k_steps, step_body, init)
+
+        gpr_out[0] = gl
+        rip_out[0, 0], rip_out[0, 1] = rip_l[0], rip_l[1]
+        rf_out[0, 0] = rf_lo
+        rf_out[0, 1] = rf_in[0, 1]      # hot classes never touch bits 32+
+        st_out[0] = status
+        ic_out[0, 0], ic_out[0, 1] = ic_l[0], ic_l[1]
+        bp_out[0] = bpskip
+        delta = jnp.zeros(N_CTRS, jnp.uint32)
+        delta = delta.at[CTR_INSTR].set(d_instr)
+        delta = delta.at[CTR_DECODE_MISS].set(d_miss)
+        # every kernel-retired instruction is by definition a fused one
+        delta = delta.at[CTR_FUSED].set(d_instr)
+        ctr_out[0] = ctr_in[0] + delta
+
+    return kernel
+
+
+def make_run_fused(k_steps: int, interpret: Optional[bool] = None):
+    """Build (or fetch) the jitted fused-step executor: up to `k_steps`
+    hot-subset instructions per lane per dispatch.
+
+    `interpret=None` auto-selects: real Mosaic lowering on a TPU backend,
+    the Pallas interpreter elsewhere (the tier-1/CPU validation mode)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    key = (k_steps, interpret)
+    cached = _FUSED_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    from jax.experimental import pallas as pl
+
+    @jax.jit
+    def run_fused(tab: UopTable, image: MemImage, machine: Machine, limit):
+        n_lanes = machine.status.shape[0]
+        n_fields = tab.meta_i32.shape[1]
+        hash_size = tab.hash_tab.shape[0]
+        capacity = tab.rip_l.shape[0]
+        nframes = image.frame_table.shape[0]
+        slots = machine.overlay.pfn.shape[1]
+        cov_w = machine.cov.shape[1]
+        edge_w = machine.edge.shape[1]
+        ebits = edge_w * 32
+        n_slots_img = image.pages.shape[0]
+
+        # u64 leaves cross the kernel boundary as free u32 bitcasts
+        tmu32 = lax.bitcast_convert_type(
+            tab.meta_u64, jnp.uint32).reshape(capacity, 8)
+        pages32 = lax.bitcast_convert_type(
+            image.pages, jnp.uint32).reshape(n_slots_img, 2 * PAGE_WORDS)
+        ic32 = lax.bitcast_convert_type(machine.icount, jnp.uint32)
+        limit32 = lax.bitcast_convert_type(
+            jnp.asarray(limit, jnp.uint64).reshape(1),
+            jnp.uint32).reshape(2)
+
+        kernel = _build_kernel(k_steps, n_fields, hash_size, nframes, ebits)
+
+        def full(shape):
+            nd = len(shape)
+            return pl.BlockSpec(shape, lambda i, _n=nd: (0,) * _n)
+
+        def lane(shape_tail):
+            nd = 1 + len(shape_tail)
+            return pl.BlockSpec((1,) + shape_tail,
+                                lambda i, _n=nd: (i,) + (0,) * (_n - 1))
+
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_lanes,),
+            in_specs=[
+                full((hash_size,)),
+                full((capacity, 2)),
+                full((capacity, n_fields)),
+                full((capacity, 8)),
+                full((n_slots_img, 2 * PAGE_WORDS)),
+                full((nframes,)),
+                lane((slots,)),
+                full((2,)),
+                lane((16, 2)),
+                lane((2,)),
+                lane((2,)),
+                lane(()),
+                lane((2,)),
+                lane(()),
+                lane((N_CTRS,)),
+                lane((cov_w,)),
+                lane((edge_w,)),
+            ],
+            out_specs=[
+                lane((16, 2)),
+                lane((2,)),
+                lane((2,)),
+                lane(()),
+                lane((2,)),
+                lane(()),
+                lane((N_CTRS,)),
+                lane((cov_w,)),
+                lane((edge_w,)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n_lanes, 16, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((n_lanes,), jnp.int32),
+                jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((n_lanes,), jnp.int32),
+                jax.ShapeDtypeStruct((n_lanes, N_CTRS), jnp.uint32),
+                jax.ShapeDtypeStruct((n_lanes, cov_w), jnp.uint32),
+                jax.ShapeDtypeStruct((n_lanes, edge_w), jnp.uint32),
+            ],
+            interpret=interpret,
+        )(tab.hash_tab, tab.rip_l, tab.meta_i32, tmu32, pages32,
+          image.frame_table, machine.overlay.pfn, limit32,
+          machine.gpr_l, machine.rip_l, machine.rflags_l, machine.status,
+          ic32, machine.bp_skip, machine.ctr, machine.cov, machine.edge)
+        gpr_l, rip_l, rf_l, status, ic_out, bp_skip, ctr, cov, edge = out
+        return machine._replace(
+            gpr_l=gpr_l, rip_l=rip_l, rflags_l=rf_l, status=status,
+            icount=lax.bitcast_convert_type(ic_out, jnp.uint64),
+            bp_skip=bp_skip, ctr=ctr, cov=cov, edge=edge)
+
+    _FUSED_CACHE[key] = run_fused
+    return run_fused
+
+
+def make_run_resume(n_steps: int, donate: bool = None):
+    """The fused ladder's XLA resume leg: run a SHORT chunk of the full
+    transition function (interp/step.py) for the lanes the kernel parked,
+    so the one instruction that parked each lane retires on the precise
+    path, then control returns to the kernel.
+
+    The leg swaps statuses around the chunk: parked (NEEDS_XLA) lanes run,
+    while still-RUNNING lanes — hot lanes that simply exhausted the
+    kernel's K steps — are HELD for its duration and released after.
+    Without the hold every round would retire `n_steps` hot instructions
+    on the XLA path per lane, capping fused occupancy at K/(K+n) even on
+    all-hot code; with it, occupancy equals the stream's hot fraction.
+    `n_steps` stays small (default 1) because every XLA-retired
+    instruction is lost occupancy for lanes that park.
+
+    Same memoization/donation policy as step.make_run_chunk (donation is
+    unsound on the XLA CPU backend — see that docstring)."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    key = (n_steps, donate)
+    cached = _RESUME_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    from functools import partial
+
+    from wtf_tpu.interp.step import step_lane
+
+    step_v = jax.vmap(step_lane, in_axes=(None, None, 0, None))
+    running = jnp.int32(_RUNNING)
+    parked = jnp.int32(_NEEDS_XLA)
+
+    @partial(jax.jit, donate_argnums=(2,) if donate else ())
+    def run_resume(tab: UopTable, image: MemImage, machine: Machine, limit):
+        st = machine.status
+        machine = machine._replace(status=jnp.where(
+            st == parked, running, jnp.where(st == running, parked, st)))
+
+        def cond(carry):
+            i, m = carry
+            return (i < n_steps) & jnp.any(m.status == running)
+
+        def body(carry):
+            i, m = carry
+            return i + 1, step_v(tab, image, m, limit)
+
+        _, out = lax.while_loop(cond, body, (jnp.int32(0), machine))
+        # release held lanes (step_lane never emits NEEDS_XLA itself, so
+        # every remaining NEEDS_XLA is a lane held above)
+        return out._replace(status=jnp.where(
+            out.status == parked, running, out.status))
+
+    _RESUME_CACHE[key] = run_resume
+    return run_resume

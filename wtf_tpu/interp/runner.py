@@ -43,8 +43,8 @@ from wtf_tpu.cpu.interrupts import (
 )
 from wtf_tpu.interp import limbs
 from wtf_tpu.interp.machine import (
-    CTR_DECODE_MISS, CTR_INSTR, CTR_MEM_FAULT, Machine, machine_init,
-    machine_restore,
+    CTR_DECODE_MISS, CTR_FUSED, CTR_INSTR, CTR_MEM_FAULT, Machine,
+    machine_init, machine_restore,
 )
 from wtf_tpu.interp.step import make_run_chunk
 from wtf_tpu.interp.uoptable import DecodeCache
@@ -515,6 +515,11 @@ class Runner:
         deliver_exceptions: Optional[bool] = None,
         registry: Optional[Registry] = None,
         events=None,
+        fused_step: str = "off",
+        fused_k: int = 32,
+        fused_rounds: int = 8,
+        fused_resume_steps: int = 1,
+        burst_any_tier: Optional[bool] = None,
     ):
         # Telemetry: metrics registry (private unless the backend/CLI hands
         # in a shared one) + JSONL event sink (NULL swallows when unwired)
@@ -544,6 +549,30 @@ class Runner:
         # nothing on a host backend anyway.
         self._donate = jax.default_backend() != "cpu"
         self._run_chunk = make_run_chunk(chunk_steps, donate=self._donate)
+        # Fused Pallas fast path (interp/pstep.py): per chunk the runner
+        # dispatches the fused kernel first, then a SHORT XLA chunk that
+        # resumes lanes the kernel parked (NEEDS_XLA) — the park-and-
+        # resume ladder.  "auto" enables it only where the per-kernel
+        # dispatch win exists (a real TPU backend); the CPU stand-in runs
+        # it when forced with "on" (kernel under pallas interpret mode).
+        if fused_step not in ("off", "auto", "on"):
+            raise ValueError(
+                f"fused_step must be off|auto|on, got {fused_step!r}")
+        self.fused_step = fused_step
+        self.fused_enabled = fused_step == "on" or (
+            fused_step == "auto" and jax.default_backend() == "tpu")
+        self.fused_k = fused_k
+        self.fused_rounds = fused_rounds
+        self.fused_resume_steps = fused_resume_steps
+        if self.fused_enabled:
+            from wtf_tpu.interp.pstep import fused_available
+
+            if not fused_available():
+                if fused_step == "on":
+                    raise RuntimeError(
+                        "fused_step='on' but this jax build cannot run "
+                        "pallas kernels (interp/pstep.py fused_available)")
+                self.fused_enabled = False  # auto: degrade to the XLA path
         self.lane_errors: Dict[int, str] = {}
         self._smc_updates: Dict[int, int] = {}
         # Adaptive chunk growth for deep executions (BASELINE config 5 is
@@ -568,8 +597,13 @@ class Runner:
         # The burst's any-instruction tier amortizes EXPENSIVE dispatch
         # round trips (a real chip, possibly behind a tunnel); on the CPU
         # platform a dispatch is ~free and the device executes glue
-        # instructions faster than the Python oracle, so the tier is off.
-        self.burst_any_tier = jax.default_backend() != "cpu"
+        # instructions faster than the Python oracle, so the platform
+        # default is off there.  The explicit override (config/CLI
+        # --burst-any-tier) exists so the tier can run — and be benched —
+        # on the CPU platform too (VERDICT weak item 4).
+        if burst_any_tier is None:
+            burst_any_tier = jax.default_backend() != "cpu"
+        self.burst_any_tier = burst_any_tier
         # (lane, uop-entry) coverage bits and (lane, edge-index) edge bits
         # owed by oracle burst steps; OR-ed into the device bitmaps at
         # the next push
@@ -968,6 +1002,46 @@ class Runner:
         view.set_status(lane, StatusCode.RUNNING)
         return True
 
+    # -- fused Pallas fast path (interp/pstep.py) --------------------------
+    def _fused_dispatch(self, tab, limit, shape_sig, spans) -> None:
+        """One fused 'chunk': `fused_rounds` pairs of (Pallas kernel for up
+        to fused_k hot steps) -> (unpark + fused_resume_steps XLA steps for
+        parked lanes).  With resume_steps=1 every XLA-retired instruction
+        is exactly one park event, so fused occupancy equals the hot
+        fraction of the instruction stream.  Rounds stop early once no
+        lane is RUNNING (everything needs host servicing or finished)."""
+        from wtf_tpu.interp.pstep import make_run_fused, make_run_resume
+
+        run_fused = make_run_fused(self.fused_k)
+        run_resume = make_run_resume(self.fused_resume_steps,
+                                     donate=self._donate)
+        fkey = ("fused", self.fused_k, self.n_lanes, shape_sig)
+        if fkey not in _DISPATCHED_EXECUTORS:
+            _DISPATCHED_EXECUTORS.add(fkey)
+            self.events.emit("compile", kind="pallas-fused",
+                             k_steps=self.fused_k)
+        rkey = ("resume", self.fused_resume_steps, self._donate,
+                self.n_lanes, shape_sig)
+        if rkey not in _DISPATCHED_EXECUTORS:
+            _DISPATCHED_EXECUTORS.add(rkey)
+            self.events.emit("compile",
+                             chunk_steps=self.fused_resume_steps,
+                             donate=self._donate, kind="fused-resume")
+        for _ in range(max(self.fused_rounds, 1)):
+            with spans.span("pallas-step") as sp:
+                self.machine = run_fused(tab, self.physmem.image,
+                                         self.machine, limit)
+                sp.fence(self.machine.status)
+            with spans.span("device-step") as sp:
+                # resumes parked lanes; ends with NO lane in NEEDS_XLA
+                self.machine = run_resume(tab, self.physmem.image,
+                                          self.machine, limit)
+                sp.fence(self.machine.status)
+            # copy, not a view (donation note in run())
+            status = np.array(jax.device_get(self.machine.status))
+            if not (status == int(StatusCode.RUNNING)).any():
+                break
+
     # -- run loop ----------------------------------------------------------
     def run(
         self,
@@ -993,30 +1067,34 @@ class Runner:
         spans = self.registry.spans
         undeliverable: Set[int] = set()  # lanes whose IDT delivery failed
         for _ in range(max_chunks):
-            size = (self._chunk_sizes[self._chunk_level]
-                    if self.adaptive_chunks else self.chunk_steps)
-            self.stats["max_chunk_steps"] = max(
-                self.stats["max_chunk_steps"], size)
-            run_chunk = (make_run_chunk(size, donate=self._donate)
-                         if self.adaptive_chunks else self._run_chunk)
-            compile_key = (size, self._donate, self.n_lanes, shape_sig)
-            if compile_key not in _DISPATCHED_EXECUTORS:
-                # the first dispatch of this executor shape pays the XLA
-                # compile (jit compiles on call, not on make_run_chunk);
-                # its wall shows up inside the next device-step span.
-                # Process-global like the jit cache itself — a second
-                # Runner at the same (size, donate, lanes) dispatches
-                # warm and must not re-report a compile.
-                _DISPATCHED_EXECUTORS.add(compile_key)
-                self.events.emit("compile", chunk_steps=size,
-                                 donate=self._donate)
-            with spans.span("device-step") as sp:
-                self.machine = run_chunk(
-                    tab, self.physmem.image, self.machine, limit)
-                # explicit fence: JAX dispatch is async; without it this
-                # span times Python dispatch and the device time leaks
-                # into whichever later span synchronizes first
-                sp.fence(self.machine.status)
+            if self.fused_enabled:
+                self._fused_dispatch(tab, limit, shape_sig, spans)
+            else:
+                size = (self._chunk_sizes[self._chunk_level]
+                        if self.adaptive_chunks else self.chunk_steps)
+                self.stats["max_chunk_steps"] = max(
+                    self.stats["max_chunk_steps"], size)
+                run_chunk = (make_run_chunk(size, donate=self._donate)
+                             if self.adaptive_chunks else self._run_chunk)
+                compile_key = (size, self._donate, self.n_lanes, shape_sig)
+                if compile_key not in _DISPATCHED_EXECUTORS:
+                    # the first dispatch of this executor shape pays the
+                    # XLA compile (jit compiles on call, not on
+                    # make_run_chunk); its wall shows up inside the next
+                    # device-step span.  Process-global like the jit cache
+                    # itself — a second Runner at the same (size, donate,
+                    # lanes) dispatches warm and must not re-report a
+                    # compile.
+                    _DISPATCHED_EXECUTORS.add(compile_key)
+                    self.events.emit("compile", chunk_steps=size,
+                                     donate=self._donate)
+                with spans.span("device-step") as sp:
+                    self.machine = run_chunk(
+                        tab, self.physmem.image, self.machine, limit)
+                    # explicit fence: JAX dispatch is async; without it
+                    # this span times Python dispatch and the device time
+                    # leaks into whichever later span synchronizes first
+                    sp.fence(self.machine.status)
             self.stats["chunks"] += 1
             # COPY, never a zero-copy view: the machine's buffers are
             # donated into the next chunk call, and a live numpy view of
@@ -1143,6 +1221,9 @@ class Runner:
         reg.counter("device.instructions").inc(int(totals[CTR_INSTR]))
         reg.counter("device.mem_faults").inc(int(totals[CTR_MEM_FAULT]))
         reg.counter("device.decode_misses").inc(int(totals[CTR_DECODE_MISS]))
+        # instructions retired inside the fused Pallas kernel (a subset of
+        # device.instructions; their ratio is the fused-step occupancy)
+        reg.counter("device.fused_steps").inc(int(totals[CTR_FUSED]))
         return ctr
 
 

@@ -153,25 +153,6 @@ def _bitlen(x):
     return _popcnt(x)
 
 
-def _umulhi(a, b):
-    m32 = _u(0xFFFFFFFF)
-    a0, a1 = a & m32, a >> _u(32)
-    b0, b1 = b & m32, b >> _u(32)
-    ll = a0 * b0
-    lh = a0 * b1
-    hl = a1 * b0
-    hh = a1 * b1
-    mid = (ll >> _u(32)) + (lh & m32) + (hl & m32)
-    return hh + (lh >> _u(32)) + (hl >> _u(32)) + (mid >> _u(32))
-
-
-def _smulhi(a, b):
-    hi = _umulhi(a, b)
-    hi = hi - jnp.where((a >> _u(63)) != 0, b, _u(0))
-    hi = hi - jnp.where((b >> _u(63)) != 0, a, _u(0))
-    return hi
-
-
 def _mkflags(cf, pf, af, zf, sf, of):
     def bit(c, v):
         return jnp.where(c, _u(v), _u(0))
@@ -398,6 +379,180 @@ def ea_limb(disp, base, idx_scaled, seg, a32):
     flat_lo, flat_hi = L.add64(L.add64(disp, base), idx_scaled)
     flat_hi = jnp.where(a32 != 0, _z32(), flat_hi)
     return L.add64((flat_lo, flat_hi), seg)
+
+
+def shift_limb(sub, sext_f, a, filler, cl_lo, src_lo, imm_lo, cf_in,
+               opsize, rf_lo):
+    """SHIFT/ROT class on u32 limbs: shl/shr/sar/rol/ror/rcl/rcr/shld/shrd
+    plus the partial CF/OF(/ZF/SF/PF) flag image — semantics mirror the
+    deleted u64 SHIFT block bit-for-bit (which mirrored cpu/emu.py).
+
+    `a` is the dst value pair, `filler` the shld/shrd fill register (read
+    at opsize), `cl_lo`/`src_lo`/`imm_lo` the low limbs of rcx / the src
+    operand / the immediate (every count fits 6 bits after masking, so
+    the high limbs never participate).
+
+    Returns (masked result pair, new low-rflags limb, writes-result)."""
+    z = _z32()
+    one = jnp.uint32(1)
+    false = jnp.bool_(False)
+    bits = opsize.astype(jnp.uint32) * jnp.uint32(8)
+    is_shxd = (sub == U.SH_SHLD) | (sub == U.SH_SHRD)
+    cl = cl_lo & jnp.uint32(0xFF)
+    cnt_src = jnp.where(is_shxd, jnp.where(sext_f == 3, imm_lo, cl), src_lo)
+    cnt_mask = jnp.where(opsize >= 8, jnp.uint32(0x3F), jnp.uint32(0x1F))
+    count0 = cnt_src & cnt_mask
+    # rcl/rcr rotate through CF over bits+1 positions
+    is_rc = (sub == U.SH_RCL) | (sub == U.SH_RCR)
+    count = jnp.where(is_rc, count0 % (bits + one), count0)
+    # shld/shrd 16-bit with count > bits: arch-undefined; emu reduces mod bits
+    count = jnp.where(is_shxd & (count > bits), count % bits, count)
+    cnz = count != z
+    am = L.zext(a, opsize)
+    sa = L.sext(a, opsize)
+    cf01 = (jnp.where(cf_in, one, z), z)
+    c1m = count - one            # count==0 wraps >= 64: shifts yield 0
+
+    def bit0(p):
+        return (p[0] & one) != z
+
+    sh_shl_r = L.zext(L.shl64(am, count), opsize)
+    sh_shl_cf = (count <= bits) & bit0(L.shr64(am, bits - count))
+    sh_shr_r = L.shr64(am, count)
+    sh_shr_cf = (count <= bits) & bit0(L.shr64(am, c1m))
+    sh_sar_r = L.zext(L.sar64(sa, count), opsize)
+    sh_sar_cf = bit0(L.sar64(sa, c1m))        # sar64 clamps counts at 63
+    rot_c = count % bits
+    rot_cz = rot_c == z
+    sh_rol_r = L.where64(
+        rot_cz, am,
+        L.zext(L.or64(L.shl64(am, rot_c), L.shr64(am, bits - rot_c)), opsize))
+    sh_rol_cf = bit0(sh_rol_r)
+    sh_ror_r = L.where64(
+        rot_cz, am,
+        L.zext(L.or64(L.shr64(am, rot_c), L.shl64(am, bits - rot_c)), opsize))
+    sh_ror_cf = L.msb(sh_ror_r, opsize)
+    # rcl/rcr: (bits+1)-bit rotate through carry, expressed without u128
+    zero2 = (z, z)
+    sh_rcl_r = L.zext(
+        L.or64(L.or64(L.shl64(am, count), L.shl64(cf01, c1m)),
+               L.where64(count > one,
+                         L.shr64(am, bits + one - count), zero2)),
+        opsize)
+    sh_rcl_cf = jnp.where(cnz, bit0(L.shr64(am, bits - count)), cf_in)
+    sh_rcr_r = L.zext(
+        L.or64(L.or64(L.shr64(am, count), L.shl64(cf01, bits - count)),
+               L.where64(count > one,
+                         L.shl64(am, bits + one - count), zero2)),
+        opsize)
+    sh_rcr_cf = jnp.where(cnz, bit0(L.shr64(am, c1m)), cf_in)
+    sh_shld_r = L.zext(
+        L.or64(L.shl64(am, count), L.shr64(filler, bits - count)), opsize)
+    sh_shld_cf = bit0(L.shr64(am, bits - count))
+    sh_shrd_r = L.zext(
+        L.or64(L.shr64(am, count), L.shl64(filler, bits - count)), opsize)
+    sh_shrd_cf = bit0(L.shr64(am, c1m))
+
+    conds = [(sub == U.SH_SHL) | (sub == U.SH_SAL), sub == U.SH_SHR,
+             sub == U.SH_SAR, sub == U.SH_ROL, sub == U.SH_ROR,
+             sub == U.SH_RCL, sub == U.SH_RCR, sub == U.SH_SHLD,
+             sub == U.SH_SHRD]
+    r = L.select64(conds,
+                   [sh_shl_r, sh_shr_r, sh_sar_r, sh_rol_r, sh_ror_r,
+                    sh_rcl_r, sh_rcr_r, sh_shld_r, sh_shrd_r], zero2)
+    cf = L.sel(conds,
+               [sh_shl_cf, sh_shr_cf, sh_sar_cf, sh_rol_cf, sh_ror_cf,
+                sh_rcl_cf, sh_rcr_cf, sh_shld_cf, sh_shrd_cf], false)
+    count1 = count == one
+    of_keep = (rf_lo & jnp.uint32(L.OF)) != z
+    r_msb = L.msb(r, opsize)
+    am_msb = L.msb(am, opsize)
+    ror_b2 = bit0(L.shr64(sh_ror_r, bits - jnp.uint32(2)))
+    of = L.sel(conds, [
+        jnp.where(count1, r_msb != cf, of_keep),
+        jnp.where(count1, am_msb, of_keep),
+        jnp.where(count1, false, of_keep),
+        jnp.where(count1, r_msb != cf, of_keep),
+        jnp.where(count1, r_msb != ror_b2, of_keep),
+        jnp.where(count1, r_msb != cf, of_keep),
+        jnp.where(count1, am_msb != cf_in, of_keep),
+        jnp.where(count1, L.msb(L.xor64(sh_shld_r, am), opsize), false),
+        jnp.where(count1, L.msb(L.xor64(sh_shrd_r, am), opsize), false),
+    ], of_keep)
+    full = L.mkflags(cf, L.parity_even(r[0]), false,
+                     L.is_zero64(r), r_msb, of)
+    # rcl/rcr update only CF|OF; others CF|OF|ZF|SF|PF (AF untouched,
+    # mirroring the oracle's partial set_flags in emu._exec_shift)
+    mask = jnp.where(is_rc, jnp.uint32(L.CF | L.OF),
+                     jnp.uint32(L.CF | L.OF | L.ZF | L.SF | L.PF))
+    new_rf_lo = jnp.where(cnz, (rf_lo & ~mask) | (full & mask), rf_lo)
+    return r, new_rf_lo, cnz
+
+
+def mul_limb(sub, sext_f, a, b, rax, imm, opsize, rf_lo):
+    """MUL class on u32 limbs: 2/3-operand imul plus the widening
+    mul/imul forms (lo to rAX/dst, hi to rDX, 8-bit widening writes the
+    full product to AX) with the CF/OF image — mirrors the deleted u64
+    MUL block bit-for-bit.
+
+    For opsize < 8 every signed/unsigned product fits 64 bits exactly, so
+    the wide product is one mul64_lo; opsize 8 takes the high half from
+    limbs.umulhi64/smulhi64.  Returns (w1 pair — the primary write —,
+    w2 pair — the widening high half —, new low-rflags limb)."""
+    z = _z32()
+    zero2 = (z, z)
+    ones2 = (jnp.uint32(0xFFFFFFFF), jnp.uint32(0xFFFFFFFF))
+    false = jnp.bool_(False)
+    bits = opsize.astype(jnp.uint32) * jnp.uint32(8)
+    sb = L.sext(b, opsize)
+    is_mul2 = sub == U.MUL_2OP
+    mul2_a = L.where64(sext_f == 2, b, a)          # 3-op: r/m * imm
+    mul2_b = L.where64(sext_f == 2, L.zext(imm, opsize), b)
+    m2sa = L.sext(mul2_a, opsize)
+    m2sb = L.sext(mul2_b, opsize)
+    m2_full = L.mul64_lo(m2sa, m2sb)
+    mul2_lo = L.zext(m2_full, opsize)
+    mul2_of_small = ~L.eq64(m2_full, L.sext(mul2_lo, opsize))
+    m2_hi = L.smulhi64(m2sa, m2sb)
+    m2_fill = L.where64((mul2_lo[1] >> 31) != 0, ones2, zero2)
+    mul2_of = jnp.where(opsize >= 8, ~L.eq64(m2_hi, m2_fill), mul2_of_small)
+
+    # unsigned widening
+    muw_full_u = L.mul64_lo(rax, b)    # exact for opsize < 8; low64 at 8
+    muw_u_lo = L.where64(opsize >= 8, muw_full_u,
+                         L.zext(muw_full_u, opsize))
+    muw_u_hi = L.where64(opsize >= 8, L.umulhi64(rax, b),
+                         L.zext(L.shr64(muw_full_u, bits), opsize))
+    muw_u_of = ~L.is_zero64(muw_u_hi)
+    # signed widening
+    sax = L.sext(rax, opsize)
+    muw_full_s = L.mul64_lo(sax, sb)   # exact two's complement for < 8
+    muw_s_lo_small = L.zext(muw_full_s, opsize)
+    muw_s_hi64 = L.smulhi64(sax, sb)
+    muw_s_lo = L.where64(opsize >= 8, muw_full_s, muw_s_lo_small)
+    muw_s_hi = L.where64(opsize >= 8, muw_s_hi64,
+                         L.zext(L.shr64(muw_full_s, bits), opsize))
+    s_fill = L.where64((muw_full_s[1] >> 31) != 0, ones2, zero2)
+    muw_s_of = jnp.where(
+        opsize >= 8, ~L.eq64(muw_s_hi64, s_fill),
+        ~L.eq64(muw_full_s, L.sext(muw_s_lo_small, opsize)))
+    mul_wide_s = sub == U.MUL_WIDE_S
+    muw_lo = L.where64(mul_wide_s, muw_s_lo, muw_u_lo)
+    muw_hi = L.where64(mul_wide_s, muw_s_hi, muw_u_hi)
+    muw_of = jnp.where(mul_wide_s, muw_s_of, muw_u_of)
+    mul_of = jnp.where(is_mul2, mul2_of, muw_of)
+    # 8-bit widening mul writes the full product to AX (emu _exec_mul)
+    prod16 = L.zext(L.where64(mul_wide_s, muw_full_s, muw_full_u),
+                    jnp.int32(2))
+    w1 = L.where64(is_mul2, mul2_lo,
+                   L.where64(opsize == 1, prod16, muw_lo))
+    rf2 = ((rf_lo & jnp.uint32(~L.FLAGS_ARITH & 0xFFFFFFFF))
+           | L.mkflags(mul_of, L.parity_even(mul2_lo[0]), false, false,
+                       L.msb(mul2_lo, opsize), mul_of))
+    rfw = ((rf_lo & jnp.uint32(~(L.CF | L.OF) & 0xFFFFFFFF))
+           | jnp.where(mul_of, jnp.uint32(L.CF | L.OF), z))
+    new_rf_lo = jnp.where(is_mul2, rf2, rfw)
+    return w1, muw_hi, new_rf_lo
 
 
 # ---------------------------------------------------------------------------
@@ -748,164 +903,31 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     # -- 4d. integer ALU classes (ported; mirrors cpu/emu.py exactly) -----
     a, b = dst_val, src_val
     cf_in = (rf_lo & jnp.uint32(_CF)) != jnp.uint32(0)
-    cf_in_u = jnp.where(cf_in, _u(1), _u(0))
 
     # ALU (u32 limb path; the u64 image is a bitcast for mem-dst stores)
     alu_r_l, alu_rf_lo, alu_writes = alu_limb(
         sub, dst_val_l, src_val_l, cf_in, opsize, rf_lo)
     alu_r = L.to_u64(alu_r_l)
 
-    # SHIFT ----------------------------------------------------------
-    is_shxd = (sub == U.SH_SHLD) | (sub == U.SH_SHRD)
-    cl = gpr[1] & _u(0xFF)
-    cnt_src = jnp.where(is_shxd,
-                        jnp.where(sext_f == 3, imm, cl),
-                        src_val)
-    cnt_mask = jnp.where(opsize >= 8, _u(0x3F), _u(0x1F))
-    count0 = cnt_src & cnt_mask
-    # rcl/rcr rotate through CF over bits+1 positions
-    is_rc = (sub == U.SH_RCL) | (sub == U.SH_RCR)
-    count = jnp.where(is_rc, count0 % (bits_u + _u(1)), count0)
-    # shld/shrd 16-bit with count > bits: arch-undefined; emu reduces mod bits
-    count = jnp.where(is_shxd & (count > bits_u), count % bits_u, count)
-    cnz = count != _u(0)  # count==0: no write, no flag update
-    am = a & opmask
-    sa64 = _sext(a, opsize)
-
-    sh_shl_r = _shl(am, count) & opmask
-    sh_shl_cf = jnp.where(count <= bits_u,
-                          (_shr(am, bits_u - count) & _u(1)) != 0,
-                          jnp.bool_(False))
-    sh_shr_r = _shr(am, count)
-    sh_shr_cf = jnp.where(count <= bits_u,
-                          (_shr(am, count - _u(1)) & _u(1)) != 0,
-                          jnp.bool_(False))
-    sh_sar_r = (sa64.astype(jnp.int64)
-                >> jnp.minimum(count, _u(63)).astype(jnp.int64)
-                ).astype(jnp.uint64) & opmask
-    sh_sar_cf = ((sa64.astype(jnp.int64)
-                  >> jnp.minimum(count - _u(1), _u(63)).astype(jnp.int64)
-                  ).astype(jnp.uint64) & _u(1)) != 0
-    rot_c = count % bits_u
-    rot_cz = rot_c == _u(0)
-    sh_rol_r = jnp.where(rot_cz, am,
-                         (_shl(am, rot_c) | _shr(am, bits_u - rot_c)) & opmask)
-    sh_rol_cf = (sh_rol_r & _u(1)) != 0
-    sh_ror_r = jnp.where(rot_cz, am,
-                         (_shr(am, rot_c) | _shl(am, bits_u - rot_c)) & opmask)
-    sh_ror_cf = _msb(sh_ror_r, opsize) != 0
-    # rcl/rcr: (bits+1)-bit rotate through carry, expressed without u128
-    c1 = count - _u(1)
-    sh_rcl_r = (_shl(am, count) | _shl(cf_in_u, c1)
-                | jnp.where(count > _u(1), _shr(am, bits_u + _u(1) - count), _u(0))
-                ) & opmask
-    sh_rcl_cf = jnp.where(cnz, (_shr(am, bits_u - count) & _u(1)) != 0, cf_in)
-    sh_rcr_r = (_shr(am, count) | _shl(cf_in_u, bits_u - count)
-                | jnp.where(count > _u(1), _shl(am, bits_u + _u(1) - count), _u(0))
-                ) & opmask
-    sh_rcr_cf = jnp.where(cnz, (_shr(am, c1) & _u(1)) != 0, cf_in)
-    filler = _read_reg(gpr, sr, opsize)
-    sh_shld_r = (_shl(am, count) | _shr(filler, bits_u - count)) & opmask
-    sh_shld_cf = (_shr(am, bits_u - count) & _u(1)) != 0
-    sh_shrd_r = (_shr(am, count) | _shl(filler, bits_u - count)) & opmask
-    sh_shrd_cf = (_shr(am, c1) & _u(1)) != 0
-
-    sh_r = jnp.select(
-        [(sub == U.SH_SHL) | (sub == U.SH_SAL), sub == U.SH_SHR,
-         sub == U.SH_SAR, sub == U.SH_ROL, sub == U.SH_ROR,
-         sub == U.SH_RCL, sub == U.SH_RCR, sub == U.SH_SHLD,
-         sub == U.SH_SHRD],
-        [sh_shl_r, sh_shr_r, sh_sar_r, sh_rol_r, sh_ror_r,
-         sh_rcl_r, sh_rcr_r, sh_shld_r, sh_shrd_r], default=_u(0))
-    sh_cf = jnp.select(
-        [(sub == U.SH_SHL) | (sub == U.SH_SAL), sub == U.SH_SHR,
-         sub == U.SH_SAR, sub == U.SH_ROL, sub == U.SH_ROR,
-         sub == U.SH_RCL, sub == U.SH_RCR, sub == U.SH_SHLD,
-         sub == U.SH_SHRD],
-        [sh_shl_cf, sh_shr_cf, sh_sar_cf, sh_rol_cf, sh_ror_cf,
-         sh_rcl_cf, sh_rcr_cf, sh_shld_cf, sh_shrd_cf],
-        default=jnp.bool_(False))
-    count1 = count == _u(1)
-    of_keep = (rf & _u(_OF)) != 0
-    sh_msb = _msb(sh_r, opsize) != 0
-    sh_of = jnp.select(
-        [(sub == U.SH_SHL) | (sub == U.SH_SAL), sub == U.SH_SHR,
-         sub == U.SH_SAR, sub == U.SH_ROL, sub == U.SH_ROR,
-         sub == U.SH_RCL, sub == U.SH_RCR,
-         sub == U.SH_SHLD, sub == U.SH_SHRD],
-        [jnp.where(count1, sh_msb != sh_cf, of_keep),
-         jnp.where(count1, _msb(am, opsize) != 0, of_keep),
-         jnp.where(count1, jnp.bool_(False), of_keep),
-         jnp.where(count1, sh_msb != sh_cf, of_keep),
-         jnp.where(count1,
-                   sh_msb != (((sh_ror_r >> (bits_u - _u(2))) & _u(1)) != 0),
-                   of_keep),
-         jnp.where(count1, sh_msb != sh_cf, of_keep),
-         jnp.where(count1, (_msb(am, opsize) != 0) != cf_in, of_keep),
-         jnp.where(count1, (_msb(sh_shld_r ^ am, opsize)) != 0, jnp.bool_(False)),
-         jnp.where(count1, (_msb(sh_shrd_r ^ am, opsize)) != 0, jnp.bool_(False))],
-        default=of_keep)
-    sh_full = _mkflags(sh_cf, _parity_even(sh_r), jnp.bool_(False),
-                       sh_r == _u(0), sh_msb, sh_of)
-    # rcl/rcr update only CF|OF; others CF|OF|ZF|SF|PF (AF untouched,
-    # mirroring the oracle's partial set_flags in emu._exec_shift)
-    sh_mask = jnp.where(is_rc, _u(_CF | _OF), _u(_CF | _OF | _ZF | _SF | _PF))
-    sh_rf = jnp.where(cnz, (rf & ~sh_mask) | (sh_full & sh_mask), rf)
-    sh_writes = cnz
+    # SHIFT (ported u32 limb path; shift_limb is compiled standalone by
+    # tests/test_limbs.py to pin the absence of 64-bit ops) -----------
+    filler_l = _read_reg_l(glimb, sr, opsize)
+    sh_r_l, sh_rf_lo, sh_writes = shift_limb(
+        sub, sext_f, dst_val_l, filler_l, glimb[1, 0], src_val_l[0],
+        imm_l[0], cf_in, opsize, rf_lo)
+    sh_r = L.to_u64(sh_r_l)
 
     # UNARY (ported u32 limb path) ------------------------------------
     un_r_l, un_rf_lo = unary_limb(sub, dst_val_l, cf_in, opsize, rf_lo)
     un_r = L.to_u64(un_r_l)
 
-    # MUL ------------------------------------------------------------
-    sa_s, sb_s = _sext(a, opsize), _sext(b, opsize)
-    mul2_a = jnp.where(sext_f == 2, b, a)          # 3-op: r/m * imm
-    mul2_b = jnp.where(sext_f == 2, imm & opmask, b)
-    mul2_sa, mul2_sb = _sext(mul2_a, opsize), _sext(mul2_b, opsize)
-    mul2_lo = (mul2_sa * mul2_sb) & opmask
-    mul2_wide_small = (mul2_sa.astype(jnp.int64) * mul2_sb.astype(jnp.int64))
-    mul2_of_small = mul2_wide_small != _sext(mul2_lo, opsize).astype(jnp.int64)
-    mul2_hi64 = _smulhi(mul2_sa, mul2_sb)
-    mul2_of_64 = mul2_hi64 != jnp.where(
-        (mul2_lo >> _u(63)) != 0, _u(MASK64), _u(0))
-    mul2_of = jnp.where(opsize >= 8, mul2_of_64, mul2_of_small)
-
+    # MUL (ported u32 limb path; mul_limb is compiled standalone by
+    # tests/test_limbs.py to pin the absence of 64-bit ops) ----------
     rax_op = _read_reg(gpr, jnp.int32(0), opsize)
-    sax = _sext(rax_op, opsize)
-    # unsigned widening
-    muw_lo_small = (rax_op * b) & opmask
-    muw_hi_small = _shr(rax_op * b, bits_u) & opmask
-    muw_lo_64 = rax_op * b
-    muw_hi_64 = _umulhi(rax_op, b)
-    muw_u_lo = jnp.where(opsize >= 8, muw_lo_64, muw_lo_small)
-    muw_u_hi = jnp.where(opsize >= 8, muw_hi_64, muw_hi_small)
-    muw_u_of = muw_u_hi != _u(0)
-    # signed widening
-    muw_s_full_small = sax.astype(jnp.int64) * sb_s.astype(jnp.int64)
-    muw_s_lo_small = muw_s_full_small.astype(jnp.uint64) & opmask
-    muw_s_hi_small = _shr(muw_s_full_small.astype(jnp.uint64), bits_u) & opmask
-    muw_s_lo_64 = sax * sb_s
-    muw_s_hi_64 = _smulhi(sax, sb_s)
-    muw_s_lo = jnp.where(opsize >= 8, muw_s_lo_64, muw_s_lo_small)
-    muw_s_hi = jnp.where(opsize >= 8, muw_s_hi_64, muw_s_hi_small)
-    muw_s_of = jnp.where(
-        opsize >= 8,
-        muw_s_hi_64 != jnp.where((muw_s_lo_64 >> _u(63)) != 0, _u(MASK64), _u(0)),
-        muw_s_full_small != _sext(muw_s_lo_small, opsize).astype(jnp.int64))
-    mul_wide_s = sub == U.MUL_WIDE_S
-    muw_lo = jnp.where(mul_wide_s, muw_s_lo, muw_u_lo)
-    muw_hi = jnp.where(mul_wide_s, muw_s_hi, muw_u_hi)
-    muw_of = jnp.where(mul_wide_s, muw_s_of, muw_u_of)
     is_mul2 = sub == U.MUL_2OP
-    mul_of = jnp.where(is_mul2, mul2_of, muw_of)
-    mul2_msb = _msb(mul2_lo, opsize) != 0
-    mul_rf = jnp.where(
-        is_mul2,
-        (rf & ~_u(FLAGS_ARITH)) | _mkflags(
-            mul_of, _parity_even(mul2_lo), jnp.bool_(False),
-            jnp.bool_(False), mul2_msb, mul_of),
-        (rf & ~_u(_CF | _OF))
-        | jnp.where(mul_of, _u(_CF | _OF), _u(0)))
+    mul_r1_l, mul_r2_l, mul_rf_lo = mul_limb(
+        sub, sext_f, dst_val_l, src_val_l,
+        _read_reg_l(glimb, jnp.int32(0), opsize), imm_l, opsize, rf_lo)
 
     # DIV (device path: dividend fits in 64 bits; else host fallback) --
     d_lo = rax_op
@@ -1078,11 +1100,6 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
                        _u(0))
     bsw_r = jnp.sum(jnp.where(jnp.arange(8, dtype=jnp.uint64) < nb_u,
                               bsw_bytes << rev_sh, _u(0)))
-
-    # 8-bit widening mul writes the full product to AX (emu _exec_mul)
-    muw_prod16 = jnp.where(mul_wide_s,
-                           (sax * sb_s) & _u(0xFFFF),
-                           (rax_op * b) & _u(0xFFFF))
 
     # STRING (one element per step; REP iterates by re-executing) ------
     df_set = (rf & _u(_DF)) != 0
@@ -1815,8 +1832,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_(U.OPC_ALU), alu_r),
         (is_(U.OPC_SHIFT), sh_r),
         (is_(U.OPC_UNARY), un_r),
-        (is_mul, jnp.where(is_mul2, mul2_lo,
-                           jnp.where(opsize == 1, muw_prod16, muw_lo))),
+        (is_mul, L.to_u64(mul_r1_l)),
         (is_(U.OPC_DIV), div_q),
         (is_pop, l1_lo & opmask),
         (is_(U.OPC_SETCC), cc01),
@@ -1876,7 +1892,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     ], i2_)
     w2_val = opc_list([
         (is_(U.OPC_XCHG) | is_(U.OPC_XADD) | is_(U.OPC_CMPXCHG), dst_val),
-        (is_mul, muw_hi),
+        (is_mul, L.to_u64(mul_r2_l)),
         (is_(U.OPC_DIV), div_rem),
         (is_(U.OPC_RDTSC), tsc_now >> _u(32)),
         (is_(U.OPC_XGETBV), _u(0)),
@@ -1985,8 +2001,6 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     # Ported classes (ALU/UNARY) produce a u32 low-limb image; everything
     # else rides the u64 chain and splits at the seam below.
     rf_exec = opc_list([
-        (is_(U.OPC_SHIFT), sh_rf),
-        (is_mul, mul_rf),
         (is_(U.OPC_BT), bt_rf),
         (is_(U.OPC_BITSCAN), bs_rf),
         (is_string, jnp.where((s_scas | s_cmps) & ~rep_skip, str_rf, rf)),
@@ -2001,10 +2015,14 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
         (is_x87 & (sub == U.X87_COMI), x87_comi_rf),
         (is_(U.OPC_PEXT), bmi_rf),
     ], rf)
-    hot_rf = is_(U.OPC_ALU) | is_(U.OPC_UNARY)
+    hot_rf = (is_(U.OPC_ALU) | is_(U.OPC_UNARY) | is_(U.OPC_SHIFT)
+              | is_mul)
     rf_cold_lo, rf_cold_hi = L.pair(rf_exec)
     rf_exec_lo = jnp.where(
-        hot_rf, jnp.where(is_(U.OPC_ALU), alu_rf_lo, un_rf_lo), rf_cold_lo)
+        hot_rf,
+        L.sel([is_(U.OPC_ALU), is_(U.OPC_UNARY), is_(U.OPC_SHIFT)],
+              [alu_rf_lo, un_rf_lo, sh_rf_lo], mul_rf_lo),
+        rf_cold_lo)
     new_rf_lo = jnp.where(commit, rf_exec_lo | jnp.uint32(0x2), rf_lo)
     # hot classes never touch bits 32+ (arith flags live in the low limb)
     new_rf_hi = jnp.where(commit & ~hot_rf, rf_cold_hi, rf_hi)
@@ -2108,12 +2126,13 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 
     # -- bookkeeping -------------------------------------------------------
     new_icount = st.icount + jnp.where(commit, _u(1), _u(0))
-    # device-side telemetry block (machine.CTR_INSTR/MEM_FAULT/DECODE_MISS
-    # order): accumulated in-graph every step, folded into host metrics
-    # once per burst — the per-step host sync this exists to avoid.
-    # page_fault/miss already imply `enabled`, commit implies `live`.
+    # device-side telemetry block (machine.CTR_* order): accumulated
+    # in-graph every step, folded into host metrics once per burst — the
+    # per-step host sync this exists to avoid.  page_fault/miss already
+    # imply `enabled`, commit implies `live`.  CTR_FUSED stays untouched
+    # here: only the fused Pallas kernel (interp/pstep.py) retires into it.
     new_ctr = st.ctr + jnp.stack(
-        [commit, page_fault, miss]).astype(jnp.uint32)
+        [commit, page_fault, miss, jnp.bool_(False)]).astype(jnp.uint32)
     timed = commit & (limit > _u(0)) & (new_icount >= limit)
     new_rdrand = jnp.where(commit & is_(U.OPC_RDRAND), rdrand_next, st.rdrand)
     new_bp_skip = jnp.where(commit, jnp.int32(0), st.bp_skip)
