@@ -1,0 +1,27 @@
+# Developer / CI targets.  `make verify` is the PR gate: tier-1 tests
+# plus the graph-invariant linter (wtf_tpu/analysis) — both CPU-only.
+
+PY ?= python
+
+.PHONY: verify test lint lint-rebaseline slow
+
+verify: test lint
+
+# tier-1 (the ROADMAP.md command without the driver's log plumbing)
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# hot-path contract lint: fails (exit 1) on ANY finding.  JSON output so
+# CI logs carry the kernel counts + finding provenance machine-readably.
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.analysis --json
+
+# re-pin analysis/budgets.json after a PR that legitimately changes the
+# step ladder's kernel count — record the why in PERF.md (round 9)
+lint-rebaseline:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.analysis --rebaseline
+
+slow:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m slow \
+		-p no:cacheprovider

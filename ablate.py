@@ -21,41 +21,23 @@ CONFIGS = {
 
 
 def measure(name, cfg, chunk=512):
-    import jax.numpy as jnp
+    # warm-runner + chunk-timing recipe shared with bench._microbench and
+    # the linter (wtf_tpu/analysis/trace.py)
+    from wtf_tpu.analysis.trace import build_tlv_runner, timed_chunk
 
-    from wtf_tpu.harness import demo_tlv
-    from wtf_tpu.interp.runner import Runner, warm_decode_cache
-
-    snapshot = demo_tlv.build_snapshot()
-    r = Runner(snapshot, chunk_steps=chunk, **cfg)
-    payload = b"\x01\x08AAAAAAAA" * 200  # long branchy run: fills the chunk
-    warm_decode_cache(r, demo_tlv.TARGET, payload)
-    view = r.view()
-    for lane in range(cfg["n_lanes"]):
-        view.virt_write(lane, demo_tlv.INPUT_GVA, payload)
-        view.r["gpr"][lane, 2] = np.uint64(len(payload))
-    r.push(view)
-    tab = r.cache.device()
-    rc = r._run_chunk
-    t0 = time.time()
-    m = rc(tab, r.physmem.image, r.machine, jnp.uint64(1 << 40))
-    m.status.block_until_ready()
-    compile_s = time.time() - t0
-    ic0 = np.asarray(m.icount).copy()
-    t0 = time.time()
-    m2 = rc(tab, r.physmem.image, m, jnp.uint64(1 << 40))
-    m2.status.block_until_ready()
-    dt = time.time() - t0
-    instr = int((np.asarray(m2.icount) - ic0).sum())
+    # long branchy run: fills the chunk
+    r = build_tlv_runner(chunk_steps=chunk,
+                         payload=b"\x01\x08AAAAAAAA" * 200, **cfg)
+    t = timed_chunk(r)
     import jax
 
     print(json.dumps({
         "config": name, **cfg, "chunk": chunk,
         "platform": jax.devices()[0].platform,
-        "compile_s": round(compile_s, 1),
-        "chunk_wall_s": round(dt, 4),
-        "per_step_ms": round(dt / chunk * 1e3, 3),
-        "instr_per_s": round(instr / dt, 1),
+        "compile_s": round(t["compile_s"], 1),
+        "chunk_wall_s": round(t["warm_wall_s"], 4),
+        "per_step_ms": round(t["warm_wall_s"] / chunk * 1e3, 3),
+        "instr_per_s": round(t["instr"] / t["warm_wall_s"], 1),
     }), flush=True)
 
 
@@ -67,29 +49,18 @@ def fused_ab(n_lanes, limit, chunk, payload):
     the kernel occupancy — both occupancy terms come from the device
     counter block (CTR_INSTR == icount by invariant), so the ratio is
     exactly retired-in-kernel / retired."""
-    from wtf_tpu.harness import demo_tlv
+    from wtf_tpu.analysis.trace import build_tlv_runner, insert_payload
     from wtf_tpu.interp.machine import CTR_FUSED, CTR_INSTR
-    from wtf_tpu.interp.runner import Runner, warm_decode_cache
-
-    def insert(r):
-        view = r.view()
-        for lane in range(n_lanes):
-            view.virt_write(lane, demo_tlv.INPUT_GVA, payload)
-            view.r["gpr"][lane, 2] = np.uint64(len(payload))
-        r.push(view)
 
     cols = {}
     for mode in ("off", "on"):
-        r = Runner(demo_tlv.build_snapshot(), n_lanes=n_lanes,
-                   chunk_steps=chunk, fused_step=mode)
-        r.limit = limit
-        warm_decode_cache(r, demo_tlv.TARGET, payload)
-        insert(r)
+        r = build_tlv_runner(n_lanes=n_lanes, chunk_steps=chunk,
+                             payload=payload, limit=limit, fused_step=mode)
         t0 = time.time()
         r.run()                       # cold pass: compiles + decode fill
         cold_s = time.time() - t0
         r.restore()
-        insert(r)
+        insert_payload(r, payload)
         t0 = time.time()
         r.run()
         warm_s = time.time() - t0

@@ -409,43 +409,31 @@ def _deepbench(platform: str) -> dict:
 def _microbench(snapshot) -> dict:
     """Device instructions/s for a straight-line and a branchy guest
     workload, plus the per-chunk servicing floor (VERDICT round-2 item 7:
-    measure before optimizing the hot path)."""
-    import numpy as np
+    measure before optimizing the hot path).  The warm-runner +
+    chunk-timing recipe is shared with ablate.py and the linter
+    (wtf_tpu/analysis/trace.py)."""
     import jax.numpy as jnp
 
-    from wtf_tpu.harness import demo_tlv
-    from wtf_tpu.interp.runner import Runner, warm_decode_cache
+    from wtf_tpu.analysis.trace import build_tlv_runner, timed_chunk
 
     out = {}
     n_lanes = int(os.environ.get("BENCH_MICRO_LANES", "1024"))
-    r = Runner(snapshot, n_lanes=n_lanes, chunk_steps=512)
     # warm decode cache via the oracle on a long type-1 (sum loop) workload:
     # branchy (loop back-edge + record dispatch) — the realistic shape
-    payload = b"\x01\x08AAAAAAAA" * 100
-    warm_decode_cache(r, demo_tlv.TARGET, payload)
-    view = r.view()
-    for lane in range(n_lanes):
-        view.virt_write(lane, demo_tlv.INPUT_GVA, payload)
-        view.r["gpr"][lane, 2] = np.uint64(len(payload))
-    r.push(view)
-    tab = r.cache.device()
-    rc = r._run_chunk
-    m = rc(tab, r.physmem.image, r.machine, jnp.uint64(1 << 40))
-    m.status.block_until_ready()  # compile + first chunk
-    ic0 = np.asarray(m.icount).copy()  # m is donated into the next call
-    t0 = time.time()
-    m2 = rc(tab, r.physmem.image, m, jnp.uint64(1 << 40))
-    m2.status.block_until_ready()
-    dt = time.time() - t0
-    instr = int((np.asarray(m2.icount) - ic0).sum())
-    out["branchy_instr_per_s"] = round(instr / dt, 1)
-    out["chunk512_wall_s"] = round(dt, 4)
+    r = build_tlv_runner(n_lanes=n_lanes, chunk_steps=512,
+                         payload=b"\x01\x08AAAAAAAA" * 100,
+                         snapshot=snapshot)
+    t = timed_chunk(r)
+    out["branchy_instr_per_s"] = round(t["instr"] / t["warm_wall_s"], 1)
+    out["chunk512_wall_s"] = round(t["warm_wall_s"], 4)
     # servicing floor: chunk call with every lane terminal (early exit) —
     # pure dispatch+transfer overhead per host<->device round trip
-    t0 = time.time()
     from wtf_tpu.core.results import StatusCode
 
-    m3 = rc(tab, r.physmem.image,
+    m2 = r.machine
+    rc = r.chunk_executor()
+    t0 = time.time()
+    m3 = rc(r.cache.device(), r.physmem.image,
             m2._replace(status=jnp.full_like(m2.status, int(StatusCode.OK))),
             jnp.uint64(1 << 40))
     m3.status.block_until_ready()

@@ -1,0 +1,383 @@
+"""Graph-invariant linter tests (wtf_tpu/analysis).
+
+Two layers:
+
+  * negative paths (ISSUE 5 satellite): each rule family gets a seeded
+    violation — a u64 op in a "ported" path, a gather over budget, a
+    weak-typed operand / value captured in a trace, a pstep/step opclass
+    mismatch — and must fire its NAMED rule with actionable provenance
+    (rule + entry point + primitive);
+  * clean paths: the cheap families (parity, donation policy, seam)
+    against the real tree; the full `run_lint` (which compiles the step
+    ladder, ~30s) runs in the slow tier — tier-1 covers the dtype family
+    through tests/test_limbs.py instead.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wtf_tpu.analysis.findings import Finding
+from wtf_tpu.analysis.parity import (
+    check_fused_parity, kernel_hot_opclasses, step_unsupported_opclasses,
+)
+from wtf_tpu.analysis.rules import (
+    check_budget, check_donation_aliasing, check_no_u64,
+    check_runner_donation_policy, check_seam_bitcast_only,
+    check_signature_stable, check_strong_inputs, count_data_dependent_ops,
+    load_budgets, run_dtype_family, run_lint,
+)
+from wtf_tpu.analysis.trace import compiled_hlo, lower_jit
+
+P = (jnp.uint32(0x55667788), jnp.uint32(0x11223344))
+
+
+# ---------------------------------------------------------------------------
+# dtype family
+# ---------------------------------------------------------------------------
+
+def test_no_u64_rule_fires_on_seeded_u64_op():
+    """A 64-bit add smuggled into a 'ported' path must fire dtype.no-u64
+    with the dtype named and the entry point attached."""
+    def bad(p):
+        wide = p[0].astype(jnp.uint64) | (p[1].astype(jnp.uint64) << 32)
+        return wide + jnp.uint64(1)
+
+    findings = check_no_u64(bad, P, entry="seeded.bad_path")
+    assert findings, "seeded u64 op not detected"
+    assert all(f.rule == "dtype.no-u64" for f in findings)
+    assert any(f.primitive == "u64" for f in findings)
+    assert all(f.entry == "seeded.bad_path" for f in findings)
+
+
+def test_no_u64_rule_clean_on_limb_path():
+    from wtf_tpu.interp import limbs as L
+
+    assert check_no_u64(L.add64, P, P, entry="limbs.add64") == []
+
+
+def test_seam_rule_allows_bitcast_forbids_arith():
+    from wtf_tpu.interp import limbs as L
+
+    v32 = jnp.zeros((4, 2), jnp.uint32)
+    assert check_seam_bitcast_only(L.pack_u64, v32,
+                                   entry="limbs.pack_u64") == []
+
+    def leaky(x32):
+        return L.pack_u64(x32) + jnp.uint64(1)   # arithmetic on the seam
+
+    findings = check_seam_bitcast_only(leaky, v32, entry="seeded.seam")
+    assert any(f.rule == "dtype.seam-bitcast-only" and f.primitive == "add"
+               for f in findings), findings
+
+
+def test_unpinned_ported_path_is_a_finding():
+    """A path exported via step.PORTED_LIMB_PATHS without an argument
+    recipe in the analyzer must fail the lint, not silently dodge the
+    zero-u64 pin."""
+    from wtf_tpu.interp import step as S
+
+    exports = dict(S.PORTED_LIMB_PATHS)
+    exports["step.freshly_ported_thing"] = lambda x: x
+    # compile_paths=False: the completeness check alone (the compiled
+    # no-u64 sweep over the real recipes runs in test_limbs / the lint)
+    findings = run_dtype_family(exports=exports, compile_paths=False)
+    assert [(f.rule, f.entry) for f in findings] == [
+        ("dtype.unpinned", "step.freshly_ported_thing")]
+    assert run_dtype_family(compile_paths=False) == []
+
+
+# ---------------------------------------------------------------------------
+# budget family
+# ---------------------------------------------------------------------------
+
+def test_budget_rule_fires_on_extra_gather():
+    """A real mini-compile with a data-dependent gather, checked against
+    a zero budget: the rule must name the op kind, the measured count,
+    and the pinned value."""
+    def gathery(img, idx):
+        return img[idx] + img[idx + 1]
+
+    text = compiled_hlo(gathery, jnp.arange(64, dtype=jnp.int32),
+                        jnp.int32(3))
+    counts = count_data_dependent_ops(text)
+    assert counts["total"] >= 1, counts
+    budget = {k: 0 for k in counts}
+    findings = check_budget(counts, budget, entry="seeded.gathery")
+    assert findings
+    f = findings[-1]           # the "total" row
+    assert f.rule == "budget.kernel-count"
+    assert f.primitive == "total"
+    assert f.count == counts["total"] and f.budget == 0
+    assert "rebaseline" in f.message
+
+
+def test_budget_rule_fires_on_improvement_too():
+    """The pin is exact: dropping below budget is also a finding (force a
+    conscious re-baseline), and a matching tree is clean."""
+    counts = {"gather": 2, "dynamic-slice": 0, "dynamic-update-slice": 0,
+              "scatter": 0, "total": 2}
+    assert check_budget(counts, dict(counts), entry="e") == []
+    low = check_budget(counts, {**counts, "gather": 5, "total": 5},
+                       entry="e")
+    assert {f.primitive for f in low} == {"gather", "total"}
+    assert all("under" in f.message for f in low)
+
+
+def test_checked_in_budget_matches_perf_record():
+    """analysis/budgets.json pins the step ladder at the PERF.md round-8
+    math: 168 surviving data-dependent kernels (81/59/28)."""
+    budget = load_budgets()["xla_step"]
+    assert budget["total"] == 168
+    assert (budget["gather"], budget["dynamic-slice"],
+            budget["dynamic-update-slice"]) == (81, 59, 28)
+
+
+# ---------------------------------------------------------------------------
+# recompile family
+# ---------------------------------------------------------------------------
+
+def test_weak_type_rule_fires_on_python_scalar_operand():
+    findings = check_strong_inputs((jnp.zeros(3, jnp.uint32), 1.5),
+                                   entry="seeded.executor")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "recompile.weak-type"
+    assert "weak" in f.primitive and f.entry == "seeded.executor"
+    # committed dtypes are clean
+    assert check_strong_inputs(
+        (jnp.zeros(3, jnp.uint32), jnp.uint64(5)), entry="e") == []
+
+
+def test_signature_instability_rule_fires_on_value_capture():
+    """A python value captured by the trace (the retrace-per-value
+    hazard) shows up as differing lowerings of the 'same' executor."""
+    state = {"k": 1.0}
+
+    def capturing(x):
+        return x * state["k"]
+
+    # fresh lambda per lowering: jax's trace cache keys on function
+    # identity, and the real probe (trace.step_executor_lowering) re-jits
+    # a fresh closure for the same reason
+    x = jnp.zeros(4, jnp.float32)
+    text_a = lower_jit(lambda v: capturing(v), x).as_text()
+    state["k"] = 2.0
+    text_b = lower_jit(lambda v: capturing(v), x).as_text()
+    findings = check_signature_stable(text_a, text_b,
+                                      entry="seeded.capturing")
+    assert len(findings) == 1
+    assert findings[0].rule == "recompile.signature-unstable"
+    # and a pure function is stable under perturbed same-shape inputs
+    pure = lambda x: x * 2  # noqa: E731
+    ta = lower_jit(pure, jnp.zeros(4)).as_text()
+    tb = lower_jit(pure, jnp.full(4, 9.0)).as_text()
+    assert check_signature_stable(ta, tb, entry="e") == []
+
+
+def test_donation_policy_rule():
+    class FakeRunner:
+        _donate = jax.default_backend() != "cpu"
+
+    assert check_runner_donation_policy(FakeRunner()) == []
+    FakeRunner._donate = not FakeRunner._donate
+    findings = check_runner_donation_policy(FakeRunner())
+    assert len(findings) == 1
+    assert findings[0].rule == "recompile.donation-policy"
+
+
+def test_donation_aliasing_rule_fires_on_unaliased_leaf():
+    """A donated pytree whose leaves do NOT all alias into the output
+    (here: a donated arg the function drops entirely) must be flagged
+    with the leaf path in the finding."""
+    def drops_donated(dropped, kept):
+        return {"out": kept * 2}
+
+    donated = {"buf": jnp.zeros(128, jnp.uint32)}
+    text = lower_jit(drops_donated, donated, jnp.ones(128),
+                     donate_argnums=(0,)).compile().as_text()
+    findings = check_donation_aliasing(text, donated, 0,
+                                       entry="seeded.drops_donated")
+    assert len(findings) == 1
+    assert findings[0].rule == "recompile.donation-unaliased"
+    assert "buf" in findings[0].primitive
+
+
+# ---------------------------------------------------------------------------
+# parity family
+# ---------------------------------------------------------------------------
+
+def test_parity_clean_on_real_tree():
+    assert check_fused_parity() == []
+
+
+def test_parity_extractors_see_real_sources():
+    assert "ALU" in kernel_hot_opclasses()
+    assert "SSECVT" in step_unsupported_opclasses()
+
+
+def test_parity_fires_on_kernel_claim_mismatch():
+    """Kernel hot_class grows an opclass the claim doesn't carry (or vice
+    versa): parity.claim-vs-kernel with the opclass named."""
+    pstep_src = "hot_class = ((opc == U.OPC_NOP) | (opc == U.OPC_PUSH))\n"
+    step_src = ("unsupported = pre_live & (is_(U.OPC_IRET))\n"
+                "x = is_(U.OPC_NOP)\n")
+    findings = check_fused_parity(claimed={"NOP"}, pstep_src=pstep_src,
+                                  step_src=step_src)
+    assert [ (f.rule, f.primitive) for f in findings ] == [
+        ("parity.claim-vs-kernel", "OPC_PUSH")]
+    assert "pstep" in findings[0].entry
+
+
+def test_parity_fires_on_unsupported_overlap():
+    """A claimed in-kernel opclass appearing in step.py's oracle-diverting
+    `unsupported` expression: the park/resume seam would diverge."""
+    pstep_src = "hot_class = (opc == U.OPC_JCC)\n"
+    step_src = "unsupported = pre_live & (is_(U.OPC_JCC))\n"
+    findings = check_fused_parity(claimed={"JCC"}, pstep_src=pstep_src,
+                                  step_src=step_src)
+    assert ("parity.fused-vs-unsupported", "OPC_JCC") in [
+        (f.rule, f.primitive) for f in findings]
+
+
+def test_parity_resolves_intermediate_bindings():
+    """The house style routes diverting predicates through locals
+    (`movcr_bad`, `x87_oracle`) and sometimes `|=` — the rule must see
+    through both, not just literal OPC names on the final RHS."""
+    step_src = ("jcc_bad = is_(U.OPC_JCC) & weird_mode\n"
+                "unsupported = pre_live & (is_(U.OPC_IRET) | jcc_bad)\n"
+                "unsupported |= is_(U.OPC_MSR)\n")
+    assert step_unsupported_opclasses(step_src) == {"JCC", "IRET", "MSR"}
+    findings = check_fused_parity(claimed={"JCC"},
+                                  pstep_src="hot_class = (opc == U.OPC_JCC)",
+                                  step_src=step_src)
+    assert ("parity.fused-vs-unsupported", "OPC_JCC") in [
+        (f.rule, f.primitive) for f in findings]
+    # the real tree resolves through its intermediates too
+    assert {"MOVCR", "DIV", "X87"} <= step_unsupported_opclasses()
+
+
+def test_parity_fires_on_missing_step_dispatch():
+    pstep_src = "hot_class = (opc == U.OPC_MOV)\n"
+    step_src = "unsupported = pre_live & (is_(U.OPC_IRET))\n"
+    findings = check_fused_parity(claimed={"MOV"}, pstep_src=pstep_src,
+                                  step_src=step_src)
+    assert [(f.rule, f.primitive) for f in findings] == [
+        ("parity.fused-vs-dispatch", "OPC_MOV")]
+
+
+# ---------------------------------------------------------------------------
+# telemetry report: compile events per executor shape + churn warning
+# ---------------------------------------------------------------------------
+
+def _report(path):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import telemetry_report
+
+    return telemetry_report.summarize(path)
+
+
+def test_report_surfaces_compile_shapes_and_churn(tmp_path, capsys):
+    """ISSUE 5 satellite: >1 compile for one executor shape is shape-churn
+    and must surface as a warning, not stay buried in the JSONL."""
+    events = [
+        {"ts": 1.0, "seq": 0, "type": "run-start", "subcommand": "t"},
+        {"ts": 1.1, "seq": 1, "type": "compile", "chunk_steps": 64,
+         "donate": False},
+        {"ts": 1.2, "seq": 2, "type": "compile", "chunk_steps": 1024,
+         "donate": False},
+        {"ts": 1.3, "seq": 3, "type": "compile", "chunk_steps": 64,
+         "donate": False},
+        {"ts": 2.0, "seq": 4, "type": "run-end", "metrics": {}},
+    ]
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    summary = _report(path)
+    assert summary["compiles"]["total"] == 3
+    assert summary["compiles"]["by_shape"]["chunk_steps=64,donate=False"] == 2
+    assert summary["compile_shape_churn"] == {
+        "chunk_steps=64,donate=False": 2}
+
+    import telemetry_report
+
+    telemetry_report._print_human(summary)
+    out = capsys.readouterr().out
+    assert "shape-churn" in out and "compiled 2x" in out
+
+
+def test_report_no_churn_for_distinct_shapes(tmp_path):
+    events = [
+        {"ts": 1.0, "seq": 0, "type": "run-start", "subcommand": "t"},
+        {"ts": 1.1, "seq": 1, "type": "compile", "chunk_steps": 64,
+         "donate": False},
+        {"ts": 1.2, "seq": 2, "type": "compile", "kind": "pallas-fused",
+         "k_steps": 32},
+        {"ts": 2.0, "seq": 3, "type": "run-end", "metrics": {}},
+    ]
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    summary = _report(path)
+    assert summary["compiles"]["total"] == 2
+    assert summary["compile_shape_churn"] == {}
+
+
+# ---------------------------------------------------------------------------
+# findings plumbing + full lint
+# ---------------------------------------------------------------------------
+
+def test_finding_formats_provenance():
+    f = Finding(rule="budget.kernel-count", entry="xla_step",
+                primitive="gather", message="over", count=90, budget=81)
+    assert f.as_dict() == {"rule": "budget.kernel-count",
+                           "entry": "xla_step", "primitive": "gather",
+                           "message": "over", "count": 90, "budget": 81}
+    s = str(f)
+    assert "gather" in s and "90" in s and "81" in s
+
+
+def test_lint_cli_parity_only_with_telemetry(tmp_path, capsys):
+    """The CLI path end to end on the cheap family: clean exit, CLEAN
+    line, and a well-formed events.jsonl (run-start / run-end)."""
+    from wtf_tpu.analysis import main
+
+    rc = main(["--families", "parity", "--telemetry-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "CLEAN" in out
+
+    from wtf_tpu.telemetry import read_events
+
+    types = [r["type"] for r in read_events(tmp_path / "events.jsonl")]
+    assert types[0] == "run-start" and types[-1] == "run-end"
+
+
+def test_lint_unknown_family_rejected():
+    with pytest.raises(ValueError, match="unknown lint families"):
+        run_lint(families=["nonsense"])
+
+
+def test_rebaseline_without_budget_family_rejected():
+    """--rebaseline with a families filter that skips `budget` must fail
+    loudly, not silently leave the pin stale."""
+    with pytest.raises(ValueError, match="rebaseline"):
+        run_lint(families=["parity"], rebaseline=True)
+
+
+@pytest.mark.slow
+def test_full_lint_clean_on_tree(tmp_path):
+    """The acceptance gate: all four families against the real tree —
+    compiles the step ladder (~30s on the 1-core box), so slow tier;
+    tier-1 covers dtype via test_limbs and parity/negative paths above."""
+    from wtf_tpu.telemetry import Registry
+
+    registry = Registry()
+    findings, info = run_lint(registry=registry)
+    assert findings == [], [str(f) for f in findings]
+    assert info["kernel_counts"]["total"] == 168
+    assert registry.dump().get("analysis.families_run") == 4

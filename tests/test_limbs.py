@@ -13,18 +13,19 @@ Two jobs:
    decode-cache hash probe — and assert the optimized HLO contains ZERO
    64-bit integer ops.  This is what keeps a future edit from silently
    reintroducing u64 (XLA would lower it to a u32 pair on TPU and Pallas
-   would reject it outright) on the paths this PR ported.
+   would reject it outright) on the paths this PR ported.  Since ISSUE 5
+   the contract lives in the analysis rule API (wtf_tpu/analysis/rules.py
+   dtype family, enumerated from step.PORTED_LIMB_PATHS) — this file just
+   runs the family, so the tests and `wtf-tpu lint` can never disagree.
 """
 
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from wtf_tpu.interp import limbs as L
 from wtf_tpu.interp import step as S
-from wtf_tpu.interp.uoptable import UopTable
 from wtf_tpu.utils.hashing import mix64, splitmix64
 
 MASK64 = (1 << 64) - 1
@@ -271,74 +272,21 @@ def test_eval_cond_table():
 
 
 # ---------------------------------------------------------------------------
-# the no-u64 guard for the ported step paths
+# the no-u64 guard for the ported step paths (one source of truth:
+# wtf_tpu/analysis — ISSUE 5 satellite migrated the ad-hoc string greps)
 # ---------------------------------------------------------------------------
 
-def _assert_no_u64(fn, *args, name=""):
-    text = jax.jit(fn).lower(*args).compile().as_text()
-    assert "u64[" not in text and "s64[" not in text, (
-        f"64-bit integer ops reintroduced in ported path {name or fn}")
+def test_hlo_ported_paths_are_u64_free():
+    """The zero-u64/s64 (and float-free) HLO pin over EVERY enumerated
+    ported path — the limb library, the step ALU/unary/shift/mul/EA
+    cores, the decode-cache probe, the Pallas-bound register-file writer,
+    and the pack/unpack bitcast-only seam — via the analysis dtype rule
+    family (what `wtf-tpu lint` runs; step.PORTED_LIMB_PATHS is the
+    enumeration, so a newly ported path is covered by being exported)."""
+    from wtf_tpu.analysis.rules import run_dtype_family
 
-
-def _u32s(*vals):
-    return tuple(jnp.uint32(v) for v in vals)
-
-
-def test_hlo_limb_library_is_u64_free():
-    p = _u32s(0x55667788, 0x11223344)
-    q = _u32s(0xDEADBEEF, 0x12345678)
-    cin = jnp.bool_(True)
-    n = jnp.int32(4)
-    s = jnp.uint32(33)
-    _assert_no_u64(lambda a, b, c: L.adc64(a, b, c), p, q, cin, name="adc64")
-    _assert_no_u64(lambda a, b, c: L.sbb64(a, b, c), p, q, cin, name="sbb64")
-    _assert_no_u64(lambda a, k: L.shl64(a, k), p, s, name="shl64")
-    _assert_no_u64(lambda a, k: L.shr64(a, k), p, s, name="shr64")
-    _assert_no_u64(lambda a, k: L.sar64(a, k), p, s, name="sar64")
-    _assert_no_u64(lambda a, k: L.rol64(a, k), p, s, name="rol64")
-    _assert_no_u64(lambda a, b: L.mul64_lo(a, b), p, q, name="mul64_lo")
-    _assert_no_u64(lambda a: L.splitmix64(a), p, name="splitmix64")
-    _assert_no_u64(lambda a, k: L.sext(a, k), p, n, name="sext")
-    _assert_no_u64(lambda a, b, r, k, c: L.flags_add(a, b, r, k, c),
-                   p, q, p, n, cin, name="flags_add")
-    _assert_no_u64(lambda a, b, r, k, c: L.flags_sub(a, b, r, k, c),
-                   p, q, p, n, cin, name="flags_sub")
-    _assert_no_u64(lambda rf, rcx, cc: L.eval_cond(rf, rcx, cc),
-                   jnp.uint32(0x246), p, jnp.int32(5), name="eval_cond")
-
-
-def test_hlo_step_alu_path_is_u64_free():
-    p = _u32s(0x55667788, 0x11223344)
-    q = _u32s(0xDEADBEEF, 0x12345678)
-    args = (jnp.int32(0), p, q, jnp.bool_(True), jnp.int32(8),
-            jnp.uint32(0x246))
-    _assert_no_u64(lambda sub, a, b, c, n, rf: S.alu_limb(sub, a, b, c, n, rf),
-                   *args, name="alu_limb")
-    _assert_no_u64(
-        lambda sub, a, c, n, rf: S.unary_limb(sub, a, c, n, rf),
-        jnp.int32(0), p, jnp.bool_(False), jnp.int32(4), jnp.uint32(0x246),
-        name="unary_limb")
-
-
-def test_hlo_step_shift_mul_paths_are_u64_free():
-    """ISSUE 4 satellite: the SHIFT/ROT and MUL opclasses are ported onto
-    the limb shift/rotate and widening-multiply helpers — the zero-u64
-    pin extends to them (PERF.md open lever 5)."""
-    p = _u32s(0x55667788, 0x11223344)
-    q = _u32s(0xDEADBEEF, 0x12345678)
-    _assert_no_u64(lambda a, b: L.umulhi64(a, b), p, q, name="umulhi64")
-    _assert_no_u64(lambda a, b: L.smulhi64(a, b), p, q, name="smulhi64")
-    _assert_no_u64(
-        lambda sub, sx, a, fill, cl, sl, il, c, n, rf: S.shift_limb(
-            sub, sx, a, fill, cl, sl, il, c, n, rf),
-        jnp.int32(4), jnp.int32(0), p, q, jnp.uint32(7), jnp.uint32(3),
-        jnp.uint32(2), jnp.bool_(True), jnp.int32(8), jnp.uint32(0x246),
-        name="shift_limb")
-    _assert_no_u64(
-        lambda sub, sx, a, b, rax, imm, n, rf: S.mul_limb(
-            sub, sx, a, b, rax, imm, n, rf),
-        jnp.int32(2), jnp.int32(0), p, q, p, q, jnp.int32(8),
-        jnp.uint32(0x246), name="mul_limb")
+    findings = run_dtype_family()
+    assert not findings, [str(f) for f in findings]
 
 
 def test_limb_shift_mul_match_bigint_reference():
@@ -415,32 +363,6 @@ def test_limb_shift_mul_match_bigint_reference():
                         f"mulhi n={nbytes} {av:#x}*{bv:#x} sub={subval}")
 
 
-def test_hlo_step_addressing_path_is_u64_free():
-    p = _u32s(0x55667788, 0x11223344)
-    q = _u32s(0xDEADBEEF, 0x12345678)
-    seg = _u32s(0x1000, 0)
-    _assert_no_u64(
-        lambda d, b, i, s, a32: S.ea_limb(d, b, S._scale_idx_l(i, s), seg,
-                                          a32),
-        p, q, p, jnp.int32(4), jnp.int32(0), name="ea_limb")
-
-
-def test_hlo_uop_lookup_is_u64_free():
-    # probe-only table: the lookup touches hash_tab + rip_l exclusively,
-    # so the unused metadata leaves are u32 dummies (dtype is irrelevant
-    # to the probe; u64 dummies would show up as HLO parameters)
-    cap = 8
-    tab = UopTable(
-        rip_l=jnp.zeros((cap, 2), jnp.uint32),
-        meta_i32=jnp.zeros((cap, 4), jnp.int32),
-        meta_u64=jnp.zeros((cap, 4), jnp.uint32),
-        hash_tab=jnp.full((cap * 4,), -1, jnp.int32),
-    )
-    rip = _u32s(0x1000, 0x14)
-    _assert_no_u64(lambda t, r: S.uop_lookup(t, r), tab, rip,
-                   name="uop_lookup")
-
-
 def test_limb_alu_matches_u64_reference():
     """alu_limb against a direct u64 recompute of the same semantics —
     the contract the deleted u64 ALU block used to embody."""
@@ -507,8 +429,5 @@ def test_gpr_write_limb_matches_u64_reference():
                                      val_l, jnp.int32(nbytes))
                 assert (L.pack_u64(got) == want).all(), (
                     f"idx={idx} nbytes={nbytes} cond={cond}")
-    text = jax.jit(
-        lambda g, c, i, v, n: S._gpr_write_l(g, c, i, v, n)
-    ).lower(gl, jnp.bool_(True), jnp.int32(3), val_l,
-            jnp.int32(4)).compile().as_text()
-    assert "u64[" not in text and "s64[" not in text
+    # (the no-u64 HLO pin for _gpr_write_l rides the analysis dtype
+    # family — "step.gpr_write_l" in step.PORTED_LIMB_PATHS)

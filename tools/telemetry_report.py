@@ -59,12 +59,25 @@ def summarize(path) -> dict:
     by_type: dict = {}
     crashes: dict = {}
     errors = []
+    compiles_by_shape: dict = {}
     for rec in records:
         by_type[rec["type"]] = by_type.get(rec["type"], 0) + 1
         if rec["type"] == "crash" and rec.get("name"):
             crashes[rec["name"]] = crashes.get(rec["name"], 0) + 1
         elif rec["type"] == "error":
             errors.append({k: rec.get(k) for k in ("kind", "detail")})
+        elif rec["type"] == "compile":
+            # one executor "shape" = the compile event's own payload
+            # (chunk_steps/donate/kind/...) minus the stream bookkeeping
+            shape = ",".join(
+                f"{k}={rec[k]}" for k in sorted(rec)
+                if k not in ("ts", "seq", "type"))
+            compiles_by_shape[shape] = compiles_by_shape.get(shape, 0) + 1
+    # >1 compile for one executor shape means the jit cache churned (a
+    # weak-type/python-scalar signature split, or an executor rebuilt
+    # past the process-global dispatch dedup) — wall-clock silently lost
+    compile_shape_churn = {shape: n for shape, n in compiles_by_shape.items()
+                           if n > 1}
 
     phase_seconds = metrics.get("phase.seconds", {}) or {}
     if not isinstance(phase_seconds, dict):
@@ -105,6 +118,9 @@ def summarize(path) -> dict:
         "nested_phases": nested,
         "testcases": testcases,
         "testcases_per_s": round(testcases / wall, 2) if wall else None,
+        "compiles": {"total": sum(compiles_by_shape.values()),
+                     "by_shape": dict(sorted(compiles_by_shape.items()))},
+        "compile_shape_churn": dict(sorted(compile_shape_churn.items())),
         "crashes": metrics.get("campaign.crashes", 0),
         "crash_names": crashes,
         "new_coverage": metrics.get("campaign.new_coverage", 0),
@@ -156,6 +172,13 @@ def _print_human(s: dict) -> None:
             print(f"    {name:<24} {secs:>8.3f}s")
     print(f"testcases: {s['testcases']}"
           + (f" ({s['testcases_per_s']}/s)" if s["testcases_per_s"] else ""))
+    if s["compiles"]["total"]:
+        print(f"compiles: {s['compiles']['total']} executor(s)")
+        for shape, n in s["compiles"]["by_shape"].items():
+            print(f"  {shape} x{n}")
+        for shape, n in s["compile_shape_churn"].items():
+            print(f"  warning: shape-churn — {shape} compiled {n}x "
+                  f"(expected 1 per executor shape)")
     print(f"crashes: {s['crashes']} new-coverage: {s['new_coverage']}")
     if s["crash_names"]:
         for name, n in sorted(s["crash_names"].items()):

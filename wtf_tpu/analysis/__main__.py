@@ -1,0 +1,7 @@
+"""`python -m wtf_tpu.analysis` -> the graph-invariant linter CLI."""
+
+import sys
+
+from wtf_tpu.analysis import main
+
+sys.exit(main())

@@ -1,0 +1,34 @@
+"""Finding: one named lint violation with actionable provenance.
+
+Every rule in the graph-invariant linter (wtf_tpu/analysis/rules.py)
+reports violations as Finding records — rule name + entry point +
+offending primitive — so a regression shows up in CI as e.g.
+
+    dtype.no-u64 @ step.alu_limb [u64[] add]: 64-bit integer op in ported path
+
+instead of a 2x wall-clock surprise on real hardware five PRs later.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+from typing import Optional
+
+
+@dataclass
+class Finding:
+    rule: str                      # e.g. "dtype.no-u64", "budget.kernel-count"
+    entry: str                     # traced entry point (function / executor)
+    message: str                   # one-line human explanation
+    primitive: Optional[str] = None  # offending HLO op / dtype / opclass
+    count: Optional[int] = None      # measured value (budget rules)
+    budget: Optional[int] = None     # pinned value  (budget rules)
+
+    def as_dict(self) -> dict:
+        return {k: v for k, v in asdict(self).items() if v is not None}
+
+    def __str__(self) -> str:
+        extra = f" [{self.primitive}]" if self.primitive else ""
+        vs = (f" (measured {self.count} vs budget {self.budget})"
+              if self.count is not None and self.budget is not None else "")
+        return f"{self.rule} @ {self.entry}{extra}: {self.message}{vs}"

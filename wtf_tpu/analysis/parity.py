@@ -1,0 +1,155 @@
+"""Fused-subset parity: pstep's in-kernel opclass claim vs reality.
+
+interp/pstep.py (the Pallas fused-step kernel) and interp/step.py (the
+XLA transition function) duplicate instruction semantics by design — the
+kernel executes a hot subset, everything else parks to the XLA leg.
+Nothing in the runtime keeps the two in sync: a class added to the
+kernel's `hot_class` predicate but dropped from (or never present in)
+step.py's dispatch would make parked/unparked lanes diverge silently.
+
+This module makes the contract machine-checked, statically:
+
+  1. `FUSED_OPCLASSES` (pstep.py) is the *claim* — the opclass set the
+     kernel says it handles in-kernel (subject to per-uop operand
+     conditions).
+  2. The kernel's actual `hot_class = (...)` expression is AST-parsed
+     from the pstep source; its `U.OPC_*` set must equal the claim.
+  3. step.py's `unsupported = pre_live & (...)` expression is AST-parsed
+     the same way; no claimed class may appear in it, even conditionally
+     (conservative: a conditionally-diverting class has no business in
+     the always-hot kernel subset).
+  4. Every claimed class must be dispatched somewhere in step.py —
+     referenced by name — or be a documented implicit no-op (NOP/FENCE
+     commit with no writes through step_lane's default paths).
+
+Tests seed violations by passing doctored source text through the
+`*_src` parameters; the CLI lint runs against the real files.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+from typing import List, Optional, Set
+
+from wtf_tpu.analysis.findings import Finding
+
+# opclasses step_lane executes through its default no-write commit path
+# without ever naming them (hence absent from the source text)
+IMPLICIT_NOOPS = frozenset({"NOP", "FENCE"})
+
+
+def _opc_names(node: ast.AST) -> Set[str]:
+    """All `U.OPC_*` attribute references under `node`, without prefix."""
+    names: Set[str] = set()
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "U"
+                and sub.attr.startswith("OPC_")):
+            names.add(sub.attr[len("OPC_"):])
+    return names
+
+
+def _resolved_opc_names(src: str, target: str) -> Set[str]:
+    """OPC_* names reachable from every assignment to `target`, resolving
+    intermediate Name bindings transitively (the house style routes
+    predicates through locals — `movcr_bad`, `x87_oracle` — and builds
+    with `|=` sometimes; a literal-only walk of one RHS would be blind to
+    both)."""
+    defs: dict = {}
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    defs.setdefault(t.id, []).append(node.value)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name):
+                defs.setdefault(node.target.id, []).append(node.value)
+    if target not in defs:
+        raise ValueError(f"no `{target} = ...` assignment found in source")
+    names: Set[str] = set()
+    seen = {target}
+    work = [target]
+    while work:
+        for rhs in defs[work.pop()]:
+            names |= _opc_names(rhs)
+            for sub in ast.walk(rhs):
+                if (isinstance(sub, ast.Name) and sub.id in defs
+                        and sub.id not in seen):
+                    seen.add(sub.id)
+                    work.append(sub.id)
+    return names
+
+
+def _module_src(modname: str) -> str:
+    import importlib
+
+    return inspect.getsource(importlib.import_module(modname))
+
+
+def kernel_hot_opclasses(pstep_src: Optional[str] = None) -> Set[str]:
+    """Opclasses in pstep's `hot_class` predicate (the kernel's reality),
+    intermediate bindings resolved."""
+    src = pstep_src or _module_src("wtf_tpu.interp.pstep")
+    return _resolved_opc_names(src, "hot_class")
+
+
+def step_unsupported_opclasses(step_src: Optional[str] = None) -> Set[str]:
+    """Opclasses named (even conditionally, even through intermediate
+    locals like `movcr_bad`) in step_lane's `unsupported` expression —
+    the oracle-diverting set, conservatively."""
+    src = step_src or _module_src("wtf_tpu.interp.step")
+    return _resolved_opc_names(src, "unsupported")
+
+
+def step_referenced_opclasses(step_src: Optional[str] = None) -> Set[str]:
+    """Every opclass step.py references at all (dispatch superset)."""
+    src = step_src or _module_src("wtf_tpu.interp.step")
+    return _opc_names(ast.parse(src))
+
+
+def check_fused_parity(claimed: Optional[Set[str]] = None,
+                       pstep_src: Optional[str] = None,
+                       step_src: Optional[str] = None) -> List[Finding]:
+    """The fused-subset parity rule family.  Returns [] when the claim,
+    the kernel predicate, and step.py's dispatch all agree."""
+    if claimed is None:
+        from wtf_tpu.interp.pstep import FUSED_OPCLASSES
+
+        claimed = set(FUSED_OPCLASSES)
+    findings: List[Finding] = []
+
+    kernel = kernel_hot_opclasses(pstep_src)
+    for opc in sorted(kernel - claimed):
+        findings.append(Finding(
+            rule="parity.claim-vs-kernel", entry="interp/pstep.py:hot_class",
+            primitive=f"OPC_{opc}",
+            message=("kernel hot_class executes an opclass absent from "
+                     "FUSED_OPCLASSES — update the claim (and this check's "
+                     "step.py cross-checks will vet it)")))
+    for opc in sorted(claimed - kernel):
+        findings.append(Finding(
+            rule="parity.claim-vs-kernel", entry="interp/pstep.py:hot_class",
+            primitive=f"OPC_{opc}",
+            message=("FUSED_OPCLASSES claims an opclass the kernel "
+                     "hot_class predicate never matches — stale claim")))
+
+    unsupported = step_unsupported_opclasses(step_src)
+    for opc in sorted(claimed & unsupported):
+        findings.append(Finding(
+            rule="parity.fused-vs-unsupported",
+            entry="interp/step.py:unsupported", primitive=f"OPC_{opc}",
+            message=("opclass claimed in-kernel by pstep appears in "
+                     "step.py's oracle-diverting `unsupported` expression "
+                     "— a parked lane would diverge from the kernel")))
+
+    referenced = step_referenced_opclasses(step_src) | IMPLICIT_NOOPS
+    for opc in sorted(claimed - referenced):
+        findings.append(Finding(
+            rule="parity.fused-vs-dispatch", entry="interp/step.py",
+            primitive=f"OPC_{opc}",
+            message=("opclass claimed in-kernel by pstep is never "
+                     "dispatched by step.py (and is not a documented "
+                     "implicit no-op) — the resume leg cannot execute it")))
+    return findings

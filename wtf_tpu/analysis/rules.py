@@ -1,0 +1,510 @@
+"""The graph-invariant rule engine: four families of static checks.
+
+Each rule traces a *real* entry point (the chunked XLA step ladder, the
+ported u32-limb hot paths, the pack/unpack seams, overlay restore) into
+jaxpr/StableHLO/optimized-HLO on the CPU backend — no chip — and walks
+the result:
+
+  dtype     zero u64/s64/f64/f32 primitives in the ported integer-core
+            paths (the PR-2 contract, formerly ad-hoc string greps in
+            tests/test_limbs.py); the pack/unpack seam may hold 64-bit
+            values but only through free bitcasts; every path step.py
+            exports as ported must have an argument recipe here or the
+            lint fails (a newly ported path cannot dodge the pin)
+  budget    data-dependent gather/dynamic-slice/dynamic-update-slice/
+            scatter ops surviving in the compiled step ladder, pinned
+            against analysis/budgets.json (the PERF.md round-8 "168
+            surviving kernels" math as a regression gate)
+  recompile re-trace the executor under perturbed-but-same-shape inputs
+            and flag signature instability; weak-typed executor operands
+            (a python scalar passed where a committed dtype belongs —
+            the jit-cache-split hazard); donation verification (every
+            donated machine leaf actually aliased in the compiled
+            output, and the Runner's CPU-donation gate intact — the
+            PR-2 corruption class caught statically)
+  parity    the fused-subset contract between pstep.py and step.py
+            (wtf_tpu/analysis/parity.py)
+
+`run_lint` orchestrates all families and reports Findings; helpers are
+public so tests can seed violations directly.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from wtf_tpu.analysis.findings import Finding
+from wtf_tpu.analysis.parity import check_fused_parity
+from wtf_tpu.analysis.trace import (
+    build_tlv_runner, compiled_hlo, step_executor_lowering,
+)
+
+BUDGETS_PATH = Path(__file__).with_name("budgets.json")
+
+# the data-dependent-index HLO ops TPU XLA cannot fuse across — the unit
+# of the PERF.md performance model ("step wall is proportional to the
+# number of gather-class kernels, not FLOPs")
+DATA_DEP_OPS = ("gather", "dynamic-slice", "dynamic-update-slice", "scatter")
+
+# canonical budget-trace configuration: op counts are static code sites
+# (independent of n_lanes / n_steps — the chunk is a while_loop, not an
+# unroll), but the pin is only meaningful against one fixed entry shape
+BUDGET_ENTRY = "xla_step"
+BUDGET_CONFIG = dict(n_lanes=4, chunk_steps=64, n_steps=64, donate=True)
+
+FAMILIES = ("dtype", "budget", "recompile", "parity")
+
+_FORBID_64 = re.compile(r"\b(u64|s64|f64|f32)\[")
+# jaxpr primitives that move/reshape bits without computing on them (the
+# pack/unpack seam allowance; on CPU the width-changing bitcast itself
+# legitimately LOWERS to shift/or arithmetic, so the contract is checked
+# at the jaxpr level, before XLA expands it)
+_SEAM_OK = frozenset((
+    "bitcast_convert_type", "reshape", "transpose", "squeeze",
+    "broadcast_in_dim", "convert_element_type",
+))
+_ALIAS_ENTRY = re.compile(r"\((\d+), \{[^)]*?\}(?:, [a-z\-]+)?\)")
+
+
+# ---------------------------------------------------------------------------
+# dtype family
+# ---------------------------------------------------------------------------
+
+def check_no_u64(fn, *args, entry: str) -> List[Finding]:
+    """Compile fn(*args); any 64-bit (or float) typed op is a finding —
+    the ported integer-core paths are u32/bool/i32-only by contract."""
+    text = compiled_hlo(fn, *args)
+    found: Dict[str, int] = {}
+    for m in _FORBID_64.finditer(text):
+        found[m.group(1)] = found.get(m.group(1), 0) + 1
+    return [
+        Finding(rule="dtype.no-u64", entry=entry, primitive=dtype,
+                count=n,
+                message=("64-bit/float op reintroduced in a ported "
+                         "u32-limb path (XLA lowers it to a carry-chained "
+                         "u32 pair on TPU; Pallas cannot hold it at all)"))
+        for dtype, n in sorted(found.items())
+    ]
+
+
+def check_seam_bitcast_only(fn, *args, entry: str) -> List[Finding]:
+    """The pack/unpack seam may *hold* 64-bit values but must not compute
+    on them: its jaxpr may contain only bitcast / data-movement
+    primitives (the "free bitcast" contract XLA then lowers per
+    platform)."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    bad: Dict[str, int] = {}
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name not in _SEAM_OK:
+            bad[name] = bad.get(name, 0) + 1
+    return [
+        Finding(rule="dtype.seam-bitcast-only", entry=entry, primitive=op,
+                count=n,
+                message=("pack/unpack seam performs arithmetic — the "
+                         "seam contract is a free bitcast (data movement "
+                         "only), nothing more"))
+        for op, n in sorted(bad.items())
+    ]
+
+
+def _dtype_arg_recipes() -> Dict[str, Tuple]:
+    """name -> (fn, args) for every ported path the dtype family pins.
+    The step-level names must cover step.PORTED_LIMB_PATHS exactly;
+    run_dtype_family fails the lint on any export without a recipe."""
+    import jax.numpy as jnp
+
+    from wtf_tpu.interp import limbs as L
+    from wtf_tpu.interp import step as S
+    from wtf_tpu.interp.uoptable import UopTable
+
+    p = (jnp.uint32(0x55667788), jnp.uint32(0x11223344))
+    q = (jnp.uint32(0xDEADBEEF), jnp.uint32(0x12345678))
+    cin = jnp.bool_(True)
+    n4 = jnp.int32(4)
+    n8 = jnp.int32(8)
+    s = jnp.uint32(33)
+    rf = jnp.uint32(0x246)
+    cap = 8
+    tab = UopTable(
+        rip_l=jnp.zeros((cap, 2), jnp.uint32),
+        meta_i32=jnp.zeros((cap, 4), jnp.int32),
+        meta_u64=jnp.zeros((cap, 4), jnp.uint32),
+        hash_tab=jnp.full((cap * 4,), -1, jnp.int32),
+    )
+    gl = jnp.zeros((16, 2), jnp.uint32)
+    recipes: Dict[str, Tuple] = {
+        # limb library (interp/limbs.py public helpers)
+        "limbs.adc64": (L.adc64, (p, q, cin)),
+        "limbs.sbb64": (L.sbb64, (p, q, cin)),
+        "limbs.shl64": (L.shl64, (p, s)),
+        "limbs.shr64": (L.shr64, (p, s)),
+        "limbs.sar64": (L.sar64, (p, s)),
+        "limbs.rol64": (L.rol64, (p, s)),
+        "limbs.mul64_lo": (L.mul64_lo, (p, q)),
+        "limbs.umulhi64": (L.umulhi64, (p, q)),
+        "limbs.smulhi64": (L.smulhi64, (p, q)),
+        "limbs.splitmix64": (L.splitmix64, (p,)),
+        "limbs.sext": (L.sext, (p, n4)),
+        "limbs.flags_add": (L.flags_add, (p, q, p, n4, cin)),
+        "limbs.flags_sub": (L.flags_sub, (p, q, p, n4, cin)),
+        "limbs.eval_cond": (L.eval_cond, (rf, p, jnp.int32(5))),
+        # step-level ported paths (step.PORTED_LIMB_PATHS)
+        "step.alu_limb": (S.alu_limb, (jnp.int32(0), p, q, cin, n8, rf)),
+        "step.unary_limb": (S.unary_limb,
+                            (jnp.int32(0), p, jnp.bool_(False), n4, rf)),
+        "step.shift_limb": (S.shift_limb,
+                            (jnp.int32(4), jnp.int32(0), p, q, jnp.uint32(7),
+                             jnp.uint32(3), jnp.uint32(2), cin, n8, rf)),
+        "step.mul_limb": (S.mul_limb,
+                          (jnp.int32(2), jnp.int32(0), p, q, p, q, n8, rf)),
+        "step.ea_limb": (
+            lambda d, b, i, sc, a32: S.ea_limb(
+                d, b, S._scale_idx_l(i, sc), (jnp.uint32(0x1000),
+                                              jnp.uint32(0)), a32),
+            (p, q, p, n4, jnp.int32(0))),
+        "step.scale_idx_l": (S._scale_idx_l, (p, n4)),
+        "step.uop_lookup": (S.uop_lookup,
+                            (tab, (jnp.uint32(0x1000), jnp.uint32(0x14)))),
+        "step.gpr_write_l": (S._gpr_write_l,
+                             (gl, jnp.bool_(True), jnp.int32(3), p, n4)),
+    }
+    return recipes
+
+
+def run_dtype_family(exports: Optional[Dict] = None,
+                     compile_paths: bool = True) -> List[Finding]:
+    """All dtype rules: no-u64 over every enumerated ported path, the
+    seam bitcast-only check, and the completeness check that every path
+    step.py exports (`exports`, default step.PORTED_LIMB_PATHS) has a
+    recipe here — the mechanism that forces a newly ported path under
+    the pin.  compile_paths=False runs only the completeness check (the
+    compiles are the expensive part; tests that seed an unpinned export
+    don't need them)."""
+    import jax.numpy as jnp
+
+    from wtf_tpu.interp import limbs as L
+    from wtf_tpu.interp import step as S
+
+    if exports is None:
+        exports = S.PORTED_LIMB_PATHS
+    recipes = _dtype_arg_recipes()
+    findings: List[Finding] = []
+    for name in sorted(exports):
+        if name not in recipes:
+            findings.append(Finding(
+                rule="dtype.unpinned", entry=name,
+                message=("step.PORTED_LIMB_PATHS exports a ported path "
+                         "with no argument recipe in "
+                         "analysis.rules._dtype_arg_recipes — add one so "
+                         "the zero-u64 pin covers it")))
+    if not compile_paths:
+        return findings
+    # Fast path: ONE compile of every recipe bundled into a tuple-valued
+    # module (tuple outputs keep each path live, so a u64 in any entry
+    # survives into the scanned text).  Only when that sweep finds a
+    # violation do the entries recompile individually, to attach the
+    # exact entry point to the finding — clean runs (CI, tier-1) pay a
+    # single XLA pipeline instead of ~20.
+    names = sorted(recipes)
+    fns = [recipes[n][0] for n in names]
+
+    def combined(argsets):
+        return tuple(fn(*a) for fn, a in zip(fns, argsets))
+
+    quick = check_no_u64(combined, [recipes[n][1] for n in names],
+                         entry="ported-paths(combined)")
+    if quick:
+        localized: List[Finding] = []
+        for name in names:
+            fn, args = recipes[name]
+            localized.extend(check_no_u64(fn, *args, entry=name))
+        findings.extend(localized if localized else quick)
+    # the seam itself: free bitcasts only
+    v64 = jnp.arange(4, dtype=jnp.uint64)
+    v32 = jnp.zeros((4, 2), jnp.uint32)
+    findings.extend(check_seam_bitcast_only(
+        L.pack_u64, v32, entry="limbs.pack_u64"))
+    findings.extend(check_seam_bitcast_only(
+        L.unpack_u64, v64, entry="limbs.unpack_u64"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# budget family
+# ---------------------------------------------------------------------------
+
+def count_data_dependent_ops(hlo_text: str) -> Dict[str, int]:
+    """Occurrences of each gather-class op in optimized HLO text (plus
+    "total") — the kernel-count currency of PERF.md's model."""
+    counts = {}
+    for name in DATA_DEP_OPS:
+        pat = re.compile(r"(?<![\w\-])" + re.escape(name) + r"\(")
+        counts[name] = len(pat.findall(hlo_text))
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def check_budget(counts: Dict[str, int], budget: Dict[str, int],
+                 entry: str) -> List[Finding]:
+    """Exact pin: any drift (up OR down) is a finding — an improvement
+    must be re-baselined consciously (see PERF.md round 9), a regression
+    must be explained or fixed."""
+    findings = []
+    for name in list(DATA_DEP_OPS) + ["total"]:
+        got = counts.get(name, 0)
+        want = budget.get(name)
+        if want is None or got == want:
+            continue
+        direction = "over" if got > want else "under"
+        findings.append(Finding(
+            rule="budget.kernel-count", entry=entry, primitive=name,
+            count=got, budget=want,
+            message=(f"data-dependent `{name}` kernel count {direction} "
+                     "the checked-in budget — if the change is "
+                     "intentional, re-baseline with `python -m "
+                     "wtf_tpu.analysis --rebaseline` and record why in "
+                     "PERF.md")))
+    return findings
+
+
+def load_budgets(path: Optional[Path] = None) -> Dict:
+    path = Path(path) if path else BUDGETS_PATH
+    return json.loads(path.read_text())
+
+
+def save_budgets(budgets: Dict, path: Optional[Path] = None) -> Path:
+    path = Path(path) if path else BUDGETS_PATH
+    path.write_text(json.dumps(budgets, indent=2, sort_keys=True) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# recompile family
+# ---------------------------------------------------------------------------
+
+def weak_typed_leaves(args) -> List[Tuple[str, str]]:
+    """(path, dtype) for every weak-typed leaf in an argument pytree —
+    each is a python scalar crossing the jit boundary where a committed
+    dtype belongs, and a second call site with the strong dtype splits
+    the jit cache (one executor shape, two compiles)."""
+    import jax
+
+    out = []
+    flat = jax.tree_util.tree_flatten_with_path(args)[0]
+    for path, leaf in flat:
+        aval = jax.core.get_aval(leaf)
+        if getattr(aval, "weak_type", False):
+            out.append((jax.tree_util.keystr(path), str(aval.dtype)))
+    return out
+
+
+def check_strong_inputs(args, entry: str) -> List[Finding]:
+    return [
+        Finding(rule="recompile.weak-type", entry=entry,
+                primitive=f"{path}: {dtype} (weak)",
+                message=("weak-typed executor operand — a python scalar "
+                         "leaked to the dispatch seam; pass a committed "
+                         "dtype (jnp.uint64(...) etc.) or the jit cache "
+                         "splits per caller convention"))
+        for path, dtype in weak_typed_leaves(args)
+    ]
+
+
+def check_signature_stable(text_a: str, text_b: str,
+                           entry: str) -> List[Finding]:
+    """Two lowerings of the same executor under perturbed-but-same-shape
+    inputs must be byte-identical StableHLO; a diff means a traced VALUE
+    (python int capture, host-dependent constant) entered the graph —
+    every such value is a silent retrace per distinct value."""
+    if text_a == text_b:
+        return []
+    for i, (la, lb) in enumerate(zip(text_a.splitlines(),
+                                     text_b.splitlines())):
+        if la != lb:
+            detail = la.strip()[:80]
+            break
+    else:
+        detail, i = "length mismatch", -1
+    return [Finding(
+        rule="recompile.signature-unstable", entry=entry,
+        primitive=f"line {i + 1}: {detail}",
+        message=("re-tracing under perturbed same-shape inputs changed "
+                 "the lowered module — a runtime value is captured in "
+                 "the trace and will force a recompile per value"))]
+
+
+def check_runner_donation_policy(runner, entry: str = "interp.runner"
+                                 ) -> List[Finding]:
+    """The PR-2 CPU-donation-corruption class, statically: on the CPU
+    backend the Runner must not request donation (XLA CPU's buffer reuse
+    corrupts live machine leaves on this graph); off-CPU it must (HBM
+    in-place updates are the point)."""
+    import jax
+
+    expected = jax.default_backend() != "cpu"
+    if bool(runner._donate) == expected:
+        return []
+    return [Finding(
+        rule="recompile.donation-policy", entry=entry,
+        primitive=f"_donate={runner._donate} on {jax.default_backend()}",
+        message=("Runner donation gate violated: donation must be OFF on "
+                 "the CPU backend (donated machine buffers corrupt there "
+                 "— the PR-2 failure class) and ON elsewhere"))]
+
+
+def check_donation_aliasing(compiled_text: str, machine,
+                            n_prefix_params: int,
+                            entry: str) -> List[Finding]:
+    """Every leaf of the donated machine argument must appear in the
+    compiled module's input_output_alias map; an unaliased donated
+    buffer is invalidated without the in-place win, and any host view of
+    it reads garbage."""
+    import jax
+
+    header = compiled_text[:compiled_text.index("\n")]
+    m = re.search(r"input_output_alias=\{(.*?)\}, entry_computation", header)
+    aliased = ({int(g.group(1)) for g in _ALIAS_ENTRY.finditer(m.group(1))}
+               if m else set())
+    flat = jax.tree_util.tree_flatten_with_path(machine)[0]
+    findings = []
+    for i, (path, _leaf) in enumerate(flat):
+        param = n_prefix_params + i
+        if param not in aliased:
+            findings.append(Finding(
+                rule="recompile.donation-unaliased", entry=entry,
+                primitive=f"machine{jax.tree_util.keystr(path)} "
+                          f"(param {param})",
+                message=("donated machine leaf not aliased in the "
+                         "compiled output — donation invalidates the "
+                         "buffer with no in-place reuse; host code "
+                         "holding a view of it reads poison")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def run_lint(families: Optional[Sequence[str]] = None,
+             budgets_path: Optional[Path] = None,
+             rebaseline: bool = False,
+             registry=None, events=None) -> Tuple[List[Finding], Dict]:
+    """Run the requested rule families (default: all) against the real
+    tree on the current (CPU) backend.  Returns (findings, info); wires
+    results into the telemetry registry under `analysis.*` and emits one
+    `lint-finding` event per finding when an event sink is given."""
+    from wtf_tpu.telemetry import NULL, Registry
+
+    registry = registry if registry is not None else Registry()
+    events = events if events is not None else NULL
+    families = list(families) if families else list(FAMILIES)
+    unknown = set(families) - set(FAMILIES)
+    if unknown:
+        raise ValueError(f"unknown lint families: {sorted(unknown)} "
+                         f"(known: {list(FAMILIES)})")
+    if rebaseline and "budget" not in families:
+        raise ValueError(
+            "--rebaseline rewrites the kernel-count budget, which only the "
+            "'budget' family measures — drop the families filter or "
+            "include budget in it")
+    findings: List[Finding] = []
+    info: Dict = {"families": families, "seconds": {}, "entries": []}
+
+    needs_trace = {"budget", "recompile"} & set(families)
+    runner = None
+    if needs_trace:
+        t0 = time.time()
+        runner = build_tlv_runner(
+            n_lanes=BUDGET_CONFIG["n_lanes"],
+            chunk_steps=BUDGET_CONFIG["chunk_steps"], payload=None)
+        lowered = step_executor_lowering(
+            runner, n_steps=BUDGET_CONFIG["n_steps"],
+            donate=BUDGET_CONFIG["donate"])
+        info["seconds"]["trace"] = round(time.time() - t0, 1)
+        info["entries"].append(
+            f"make_run_chunk({BUDGET_CONFIG['n_steps']}, "
+            f"donate={BUDGET_CONFIG['donate']}) / demo_tlv / "
+            f"n_lanes={BUDGET_CONFIG['n_lanes']}")
+
+    if "dtype" in families:
+        t0 = time.time()
+        findings.extend(run_dtype_family())
+        info["seconds"]["dtype"] = round(time.time() - t0, 1)
+
+    compiled_text = None
+    if "budget" in families:
+        t0 = time.time()
+        compiled_text = lowered.compile().as_text()
+        counts = count_data_dependent_ops(compiled_text)
+        info["kernel_counts"] = counts
+        if rebaseline:
+            budgets = {BUDGET_ENTRY: {
+                "entry": info["entries"][0], **counts}}
+            info["budgets_written"] = str(save_budgets(budgets,
+                                                       budgets_path))
+        else:
+            budget = load_budgets(budgets_path).get(BUDGET_ENTRY, {})
+            findings.extend(check_budget(counts, budget,
+                                         entry=info["entries"][0]))
+        for name, value in counts.items():
+            registry.gauge("analysis.kernel_count").labels(name).set(value)
+        info["seconds"]["budget"] = round(time.time() - t0, 1)
+
+    if "recompile" in families:
+        t0 = time.time()
+        entry = info["entries"][0]
+        # weak-typed operands at the dispatch seam (what Runner.run passes)
+        operands = runner.executor_operands()
+        findings.extend(check_strong_inputs(operands, entry=entry))
+        # retrace under perturbed same-shape inputs
+        perturbed = step_executor_lowering(
+            runner, n_steps=BUDGET_CONFIG["n_steps"],
+            donate=BUDGET_CONFIG["donate"], perturb=True)
+        findings.extend(check_signature_stable(
+            lowered.as_text(), perturbed.as_text(), entry=entry))
+        # overlay restore: same stability contract, cheap trace (fresh
+        # jit wrappers — the memoized executor would hit the trace cache)
+        from wtf_tpu.interp.machine import _machine_restore_impl
+        from wtf_tpu.analysis.trace import lower_jit
+
+        ra = lower_jit(lambda m, t: _machine_restore_impl(m, t),
+                       runner.machine, runner.template).as_text()
+        rb = lower_jit(lambda m, t: _machine_restore_impl(m, t),
+                       runner.machine._replace(
+                           icount=runner.machine.icount + 3),
+                       runner.template).as_text()
+        findings.extend(check_signature_stable(
+            ra, rb, entry="machine_restore"))
+        info["entries"].append("machine_restore")
+        # donation: policy gate + alias coverage of the donated executor
+        findings.extend(check_runner_donation_policy(runner))
+        if compiled_text is None:
+            compiled_text = lowered.compile().as_text()
+        import jax
+
+        n_prefix = len(jax.tree_util.tree_leaves(runner.cache.device())) \
+            + len(jax.tree_util.tree_leaves(runner.physmem.image))
+        findings.extend(check_donation_aliasing(
+            compiled_text, runner.machine, n_prefix, entry=entry))
+        info["seconds"]["recompile"] = round(time.time() - t0, 1)
+
+    if "parity" in families:
+        t0 = time.time()
+        findings.extend(check_fused_parity())
+        info["seconds"]["parity"] = round(time.time() - t0, 1)
+        info["entries"].append("pstep.hot_class vs step.unsupported")
+
+    # telemetry: analysis.* namespace + one event per finding
+    registry.gauge("analysis.families_run").set(len(families))
+    for f in findings:
+        registry.counter("analysis.findings").labels(f.rule).inc()
+        events.emit("lint-finding", **f.as_dict())
+    info["n_findings"] = len(findings)
+    return findings, info

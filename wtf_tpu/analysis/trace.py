@@ -1,0 +1,132 @@
+"""Trace capture: build the real entry points and lower/compile them.
+
+Two jobs in one module:
+
+  * the warm-runner + chunk-timing recipe `ablate.py` and `bench.py`
+    both used to hand-roll (build a demo_tlv Runner, warm the decode
+    cache through the oracle, write the payload into every lane, time a
+    cold and a warm chunk dispatch) — extracted here so the benches and
+    the linter share one trace-capture path;
+  * HLO/StableHLO text capture for the rule engine
+    (wtf_tpu/analysis/rules.py): lower a jitted entry point, compile it,
+    hand the text to the rules.
+
+Heavy imports (jax, the interpreter stack) stay inside functions so
+importing this module never initializes a backend — the benches pick
+their platform after import, exactly like before.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+DEFAULT_PAYLOAD = b"\x01\x08AAAAAAAA" * 200
+
+
+def insert_payload(runner, payload: bytes) -> None:
+    """Write `payload` (demo_tlv calling convention: bytes at INPUT_GVA,
+    length in rdx) into every lane and push."""
+    from wtf_tpu.harness import demo_tlv
+
+    view = runner.view()
+    for lane in range(runner.n_lanes):
+        view.virt_write(lane, demo_tlv.INPUT_GVA, payload)
+        view.r["gpr"][lane, 2] = np.uint64(len(payload))
+    runner.push(view)
+
+
+def build_tlv_runner(n_lanes: int = 1024, chunk_steps: int = 512,
+                     payload: Optional[bytes] = DEFAULT_PAYLOAD,
+                     snapshot=None, warm: bool = True, limit: int = 0,
+                     **runner_kwargs):
+    """A demo_tlv Runner ready to dispatch: decode cache warmed through
+    the host oracle (no device compile), payload inserted in every lane.
+    `payload=None` (the linter's shape-only path) skips both."""
+    from wtf_tpu.harness import demo_tlv
+    from wtf_tpu.interp.runner import Runner, warm_decode_cache
+
+    if snapshot is None:
+        snapshot = demo_tlv.build_snapshot()
+    runner = Runner(snapshot, n_lanes=n_lanes, chunk_steps=chunk_steps,
+                    **runner_kwargs)
+    runner.limit = limit
+    if payload is not None:
+        if warm:
+            warm_decode_cache(runner, demo_tlv.TARGET, payload)
+        insert_payload(runner, payload)
+    return runner
+
+
+def timed_chunk(runner, limit: int = 1 << 40) -> dict:
+    """Dispatch the runner's chunk executor cold then warm; returns
+    {"compile_s", "warm_wall_s", "instr"}.  Leaves runner.machine at the
+    post-dispatch state (donation-safe: icount is copied, never viewed)."""
+    import jax.numpy as jnp
+
+    tab = runner.cache.device()
+    run_chunk = runner.chunk_executor()
+    image = runner.physmem.image
+    t0 = time.time()
+    m = run_chunk(tab, image, runner.machine, jnp.uint64(limit))
+    m.status.block_until_ready()
+    compile_s = time.time() - t0
+    ic0 = np.asarray(m.icount).copy()  # m is donated into the next call
+    t0 = time.time()
+    m2 = run_chunk(tab, image, m, jnp.uint64(limit))
+    m2.status.block_until_ready()
+    warm_s = time.time() - t0
+    runner.machine = m2
+    return {"compile_s": compile_s, "warm_wall_s": warm_s,
+            "instr": int((np.asarray(m2.icount) - ic0).sum())}
+
+
+# ---------------------------------------------------------------------------
+# HLO / StableHLO capture for the rule engine
+# ---------------------------------------------------------------------------
+
+def lower_jit(fn, *args, donate_argnums=()):
+    """jax.jit(fn, donate_argnums=...).lower(*args) — the pre-optimization
+    StableHLO handle (`.as_text()` is the retrace-stability fingerprint;
+    `.compile()` yields the optimized HLO the budget/dtype rules scan)."""
+    import jax
+
+    return jax.jit(fn, donate_argnums=donate_argnums).lower(*args)
+
+
+def compiled_hlo(fn, *args, donate_argnums=()):
+    """Optimized (post-XLA-pipeline) HLO text of fn(*args)."""
+    return lower_jit(fn, *args,
+                     donate_argnums=donate_argnums).compile().as_text()
+
+
+def step_executor_lowering(runner, n_steps: int = 64, donate: bool = True,
+                           perturb: bool = False):
+    """Lowered handle of the chunked XLA step ladder on this runner's
+    operands.  `perturb=True` re-traces under perturbed-but-same-shape
+    inputs (register values bumped, a different limit) — the
+    signature-stability probe: both lowerings must produce identical
+    StableHLO or something value-dependent leaked into the trace.
+
+    Each call traces FRESH (make_run_chunk(jit=False) + a new jit
+    wrapper): jax's trace cache keys on function identity, so lowering
+    the memoized executor twice would compare a cache hit against
+    itself."""
+    import jax
+    import jax.numpy as jnp
+
+    from wtf_tpu.interp.step import make_run_chunk
+
+    tab = runner.cache.device()
+    machine = runner.machine
+    limit = jnp.uint64(0)
+    if perturb:
+        machine = machine._replace(
+            gpr_l=machine.gpr_l + np.uint32(1),
+            icount=machine.icount + np.uint64(7))
+        limit = jnp.uint64(12345)
+    run_chunk = make_run_chunk(n_steps, donate=donate, jit=False)
+    jitted = jax.jit(run_chunk, donate_argnums=(2,) if donate else ())
+    return jitted.lower(tab, runner.physmem.image, machine, limit)

@@ -11,6 +11,8 @@ defaulting) and subcommands.cc:16-101 (drivers):
             (MasterSubcommand -> Server_t::Run, subcommands.cc:99-101)
   campaign  single-process fused master+node over one device batch
             (this framework's native mode; no reference equivalent)
+  lint      graph-invariant static analysis of the hot-path contracts
+            (wtf_tpu/analysis; CPU-only, no reference equivalent)
 
 Target selection is by --name over the self-registering target registry;
 --target-module imports additional harness modules first (the reference
@@ -163,6 +165,22 @@ def build_parser() -> argparse.ArgumentParser:
     camp.add_argument("--num-processes", type=int, default=None)
     camp.add_argument("--process-id", type=int, default=None)
     _add_backend_tuning(camp)
+
+    lint = sub.add_parser(
+        "lint", help="graph-invariant static analysis of the hot-path "
+                     "contracts (wtf_tpu/analysis; CPU-only, no chip)")
+    lint.add_argument("--json", action="store_true",
+                      help="machine-readable output (one JSON object)")
+    lint.add_argument("--families", default=None,
+                      help="comma list: dtype,budget,recompile,parity "
+                           "(default: all)")
+    lint.add_argument("--budgets", type=Path, default=None,
+                      help="alternate budgets.json")
+    lint.add_argument("--rebaseline", action="store_true",
+                      help="rewrite the kernel-count budget file from the "
+                           "current tree (record why in PERF.md)")
+    lint.add_argument("--telemetry-dir", type=Path, default=None,
+                      help="write lint findings into events.jsonl")
     return parser
 
 
@@ -421,6 +439,19 @@ def cmd_campaign(args) -> int:
         return 0 if stats.crashes == 0 else 2
 
 
+def cmd_lint(args) -> int:
+    """`wtf-tpu lint`: the graph-invariant linter (wtf_tpu/analysis),
+    telemetry-wired like every other subcommand — findings land in the
+    registry (`analysis.*`) and the JSONL stream."""
+    from wtf_tpu.analysis import lint_main
+
+    families = args.families.split(",") if args.families else None
+    with _telemetry_for(args) as (registry, events):
+        return lint_main(families=families, budgets=args.budgets,
+                         rebaseline=args.rebaseline, as_json=args.json,
+                         registry=registry, events=events)
+
+
 def cmd_snapshot(args) -> int:
     """Format conversion: the bdump-side tooling the reference leaves to
     external scripts.  npz <-> Windows crash dump both ways."""
@@ -477,8 +508,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "master": cmd_master,
         "campaign": cmd_campaign,
         "snapshot": cmd_snapshot,
+        "lint": cmd_lint,
     }[args.subcommand]
     return driver(args)
+
+
+def console_main() -> None:
+    """setuptools console-script entry (`wtf-tpu ...`)."""
+    sys.exit(main())
 
 
 if __name__ == "__main__":

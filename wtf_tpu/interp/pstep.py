@@ -71,6 +71,17 @@ _NEED_DECODE = int(StatusCode.NEED_DECODE)
 _NEEDS_XLA = int(StatusCode.NEEDS_XLA)
 _TIMEDOUT = int(StatusCode.TIMEDOUT)
 
+# The opclass set this kernel CLAIMS to execute in-kernel (each still
+# subject to the per-uop operand conditions in `hot_class` below — e.g.
+# MOV only with a register destination and reg/imm source).  The static
+# analyzer (wtf_tpu/analysis/parity.py) AST-checks this claim against
+# the actual `hot_class` predicate AND against step.py's dispatch /
+# `unsupported` expressions, so the two engines cannot drift silently.
+FUSED_OPCLASSES = frozenset({
+    "NOP", "FENCE", "MOV", "LEA", "ALU", "UNARY", "SETCC", "CMOVCC",
+    "JCC", "JMP",
+})
+
 # memoized jitted entry points, keyed (k_steps, interpret) /
 # (n_steps, donate); jit itself re-specializes per array shapes
 _FUSED_CACHE: dict = {}

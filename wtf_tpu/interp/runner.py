@@ -624,6 +624,23 @@ class Runner:
             labeled=("fallbacks_by_opclass",))
         self.stats["max_chunk_steps"] = chunk_steps
 
+    # -- trace-capture hooks (ablate.py / bench.py / wtf_tpu.analysis) -----
+    def executor_operands(self) -> Tuple:
+        """(tab, image, machine, limit) — the chunk executor's positional
+        operands, exactly as run() dispatches them.  The export hook for
+        benches and the static analyzer; no private-state reach-in."""
+        return (self.cache.device(), self.physmem.image, self.machine,
+                jnp.uint64(self.limit))
+
+    def chunk_executor(self, n_steps: Optional[int] = None,
+                       donate: Optional[bool] = None):
+        """The jitted chunk executor this runner dispatches (memoized in
+        step._CHUNK_CACHE).  Defaults follow the runner's own size and
+        platform donation policy."""
+        return make_run_chunk(
+            self.chunk_steps if n_steps is None else n_steps,
+            donate=self._donate if donate is None else donate)
+
     # -- host memory access ------------------------------------------------
     def view(self) -> HostView:
         return HostView(self)

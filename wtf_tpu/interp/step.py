@@ -612,6 +612,23 @@ def uop_lookup(tab: UopTable, rip_l):
                      e[jnp.minimum(first, PROBES - 1)], jnp.int32(-1))
 
 
+# Export hook for the static analyzer (wtf_tpu/analysis): every ported
+# u32-limb hot path, compiled standalone under the zero-u64 dtype rule.
+# Adding a newly ported path here (and an argument recipe in
+# analysis/rules.py — the lint fails on an export without one) is how it
+# comes under the pin; tests/test_limbs.py runs the same rule family.
+PORTED_LIMB_PATHS = {
+    "step.alu_limb": alu_limb,
+    "step.unary_limb": unary_limb,
+    "step.shift_limb": shift_limb,
+    "step.mul_limb": mul_limb,
+    "step.ea_limb": ea_limb,
+    "step.scale_idx_l": _scale_idx_l,
+    "step.uop_lookup": uop_lookup,
+    "step.gpr_write_l": _gpr_write_l,
+}
+
+
 def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     """Advance one lane by one instruction (vmapped over the batch).
 
@@ -2215,7 +2232,7 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 _CHUNK_CACHE: dict = {}
 
 
-def make_run_chunk(n_steps: int, donate: bool = None):
+def make_run_chunk(n_steps: int, donate: bool = None, jit: bool = True):
     """Build (or fetch) the jitted chunk executor: up to n_steps vmapped
     transitions with early exit when no lane is RUNNING.  The host runner
     (interp/runner.py) calls this in a loop, servicing lane statuses between
@@ -2240,17 +2257,23 @@ def make_run_chunk(n_steps: int, donate: bool = None):
     garbage status/fpsw/xmm reads, reproducible and gone with donation
     off).  The Runner therefore requests donation only off-CPU, where it
     actually matters (HBM); pass donate explicitly if you know better.
-    donate=None (the default) resolves to that policy lazily."""
+    donate=None (the default) resolves to that policy lazily.
+
+    jit=False returns the UNDECORATED body (a fresh closure every call,
+    never memoized): the static analyzer's retrace-stability probe needs
+    a genuinely fresh trace per lowering — jax's trace cache keys on
+    function identity, so re-lowering the memoized jitted executor would
+    never re-trace and the probe would be vacuous."""
     if donate is None:
         donate = jax.default_backend() != "cpu"
     key = (n_steps, donate)
-    cached = _CHUNK_CACHE.get(key)
-    if cached is not None:
-        return cached
+    if jit:
+        cached = _CHUNK_CACHE.get(key)
+        if cached is not None:
+            return cached
 
     step_v = jax.vmap(step_lane, in_axes=(None, None, 0, None))
 
-    @partial(jax.jit, donate_argnums=(2,) if donate else ())
     def run_chunk(tab: UopTable, image: MemImage, machine: Machine, limit):
         def cond(carry):
             i, m = carry
@@ -2264,5 +2287,9 @@ def make_run_chunk(n_steps: int, donate: bool = None):
         _, out = lax.while_loop(cond, body, (jnp.int32(0), machine))
         return out
 
+    if not jit:
+        return run_chunk
+    run_chunk = partial(jax.jit, donate_argnums=(2,) if donate else ())(
+        run_chunk)
     _CHUNK_CACHE[key] = run_chunk
     return run_chunk
