@@ -105,6 +105,53 @@ def measure_fused(n_lanes=None, limit=None, chunk=512):
     print(json.dumps(report), flush=True)
 
 
+def measure_devmut(n_lanes=None, limit=100_000, seconds=10.0):
+    """Host-mangle vs device-mangle A/B at matched lane counts (ISSUE 6):
+    the same demo_tlv campaign driven through FuzzLoop with the best
+    host engine vs the devmangle engine (wtf_tpu/devmut), reporting
+    execs/s plus the mutate-phase split — total mutate seconds, the
+    fenced device wait under mutate/device, and the residual HOST share,
+    which is the number the device engine exists to collapse.  On the
+    CPU stand-in the generation kernel competes with the interpreter for
+    the same core, so execs/s parity is the expectation there; the
+    mutate host-share collapse is the measured claim."""
+    import jax
+
+    from wtf_tpu.analysis.trace import build_tlv_campaign
+
+    if n_lanes is None:
+        n_lanes = 1024 if jax.default_backend() == "tpu" else 64
+    cols = {}
+    for mode, engine in (("host", "mangle"), ("device", "devmangle")):
+        loop = build_tlv_campaign(n_lanes=n_lanes, mutator=engine,
+                                  limit=limit, chunk_steps=512,
+                                  overlay_slots=32)
+        loop.run_one_batch()   # warmup: XLA compiles + decode servicing
+        loop.run_one_batch()
+        spans = loop.registry.spans
+        c0 = loop.stats.testcases
+        m0 = spans.seconds("mutate")
+        d0 = spans.seconds("mutate/device")
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            loop.run_one_batch()
+        dt = time.time() - t0
+        mutate_s = spans.seconds("mutate") - m0
+        mutate_dev_s = spans.seconds("mutate/device") - d0
+        cols[mode] = {
+            "execs_per_s": round((loop.stats.testcases - c0) / dt, 2),
+            "mutate_s": round(mutate_s, 4),
+            "mutate_device_s": round(mutate_dev_s, 4),
+            "mutate_host_s": round(mutate_s - mutate_dev_s, 4),
+            "mutate_share_of_wall": round(mutate_s / dt, 4),
+        }
+    print(json.dumps({
+        "config": "devmut", "n_lanes": n_lanes, "limit": limit,
+        "platform": __import__("jax").devices()[0].platform,
+        "host": cols["host"], "device": cols["device"],
+    }), flush=True)
+
+
 def measure_deep(n_lanes=1024, limit=10_000_000, seconds=30.0):
     """BASELINE-config-3-shaped end-to-end number (the same workload
     bench.py reports in its `deep` extras): mangle campaign on demo_spin
@@ -150,12 +197,14 @@ if __name__ == "__main__":
 
     faulthandler.dump_traceback_later(
         int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
-    names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused"]
+    names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused", "devmut"]
     for n in names:
         if n == "deep":
             measure_deep()
         elif n == "fused":
             measure_fused()
+        elif n == "devmut":
+            measure_devmut()
         else:
             measure(n, CONFIGS[n])
         faulthandler.cancel_dump_traceback_later()

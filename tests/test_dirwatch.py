@@ -1,0 +1,120 @@
+"""fuzz/dirwatch.py + Corpus.load_dir seam tests (ISSUE 6 satellite).
+
+These are the host-side seams the device corpus slab sits on: the
+mid-campaign seed-injection watcher and the seed-directory replay
+ordering.  Both have exact ordering contracts (biggest-first, the
+reference master's server.h:399-414 policy) and determinism contracts
+(pinned RNG -> replayable pick sequence) that were previously untested.
+"""
+
+import random
+
+import pytest
+
+from wtf_tpu.fuzz.corpus import Corpus, seed_paths
+from wtf_tpu.fuzz.dirwatch import DirWatcher
+
+
+def _write(d, name, data):
+    p = d / name
+    p.write_bytes(data)
+    return p
+
+
+class TestDirWatcher:
+    def test_initial_contents_are_not_new(self, tmp_path):
+        _write(tmp_path, "pre", b"x" * 10)
+        watcher = DirWatcher(tmp_path)
+        assert watcher.poll() == []
+
+    def test_new_files_biggest_first(self, tmp_path):
+        watcher = DirWatcher(tmp_path)
+        _write(tmp_path, "small", b"a")
+        _write(tmp_path, "big", b"b" * 100)
+        _write(tmp_path, "mid", b"c" * 10)
+        assert [p.name for p in watcher.poll()] == ["big", "mid", "small"]
+        # already-reported files never re-appear
+        assert watcher.poll() == []
+        _write(tmp_path, "later", b"d" * 5)
+        assert [p.name for p in watcher.poll()] == ["later"]
+
+    def test_missing_directory_and_subdirs(self, tmp_path):
+        watcher = DirWatcher(tmp_path / "absent")
+        assert watcher.poll() == []
+        watcher2 = DirWatcher(tmp_path)
+        (tmp_path / "subdir").mkdir()
+        _write(tmp_path, "f", b"data")
+        assert [p.name for p in watcher2.poll()] == ["f"]
+
+
+class TestCorpusLoadDir:
+    def test_biggest_first_and_content_dedup(self, tmp_path):
+        _write(tmp_path, "a-small", b"s")
+        _write(tmp_path, "b-big", b"B" * 64)
+        _write(tmp_path, "c-mid", b"m" * 8)
+        _write(tmp_path, "d-dup-of-big", b"B" * 64)   # content twin
+        corpus = Corpus.load_dir(tmp_path)
+        # replay order is size-sorted biggest first, content-deduped
+        assert list(corpus) == [b"B" * 64, b"m" * 8, b"s"]
+        assert len(corpus) == 3
+
+    def test_seed_paths_keep_dups_census(self, tmp_path):
+        _write(tmp_path, "x", b"same")
+        _write(tmp_path, "y", b"same")
+        deduped = seed_paths([tmp_path])
+        census = seed_paths([tmp_path], keep_dups=True)
+        assert len(deduped) == 1
+        assert len(census) == 2
+        # digests agree between the two modes
+        assert {d for _, d in census} == {d for _, d in deduped}
+
+    def test_pick_sequence_deterministic_under_pinned_rng(self, tmp_path):
+        """The device-corpus seeding path relies on this: load_dir with a
+        pinned RNG must yield an identical corpus AND an identical pick
+        stream across runs (mutation-stream reproducibility)."""
+        for i in range(5):
+            _write(tmp_path, f"seed{i}", bytes([i]) * (i + 1))
+        runs = []
+        for _ in range(2):
+            corpus = Corpus.load_dir(tmp_path, rng=random.Random(0x5EED))
+            runs.append([corpus.pick() for _ in range(16)])
+        assert runs[0] == runs[1]
+        assert len(set(runs[0])) > 1   # actually random over the set
+
+    def test_load_dir_items_ordering_feeds_device_slab(self, tmp_path):
+        """Iteration order (what DevMangleMutator.seed_from consumes) is
+        the replay order — stable across identical directory contents,
+        regardless of creation order."""
+        _write(tmp_path, "za", b"1" * 3)
+        _write(tmp_path, "ab", b"2" * 9)
+        other = tmp_path / "other"
+        other.mkdir()
+        _write(other, "ab2", b"2" * 9)
+        _write(other, "za2", b"1" * 3)
+        c1 = Corpus.load_dir(tmp_path)
+        c2 = Corpus.load_dir(other)
+        assert list(c1) == list(c2) == [b"2" * 9, b"1" * 3]
+
+
+def test_vanished_file_mid_scan_is_skipped(tmp_path, monkeypatch):
+    """Files disappearing between iterdir and stat/read (atomic-rename
+    temp files) must not abort the scan — both seams skip them."""
+    from pathlib import Path
+
+    _write(tmp_path, "stays", b"x" * 4)
+    ghost = _write(tmp_path, "ghost", b"y" * 8)
+    real_stat = Path.stat
+
+    def flaky_stat(self, **kw):
+        if self.name == "ghost":
+            raise OSError("vanished")
+        return real_stat(self, **kw)
+
+    monkeypatch.setattr(Path, "stat", flaky_stat)
+    watcher = DirWatcher(tmp_path / "nowhere")
+    watcher.directory = tmp_path          # bypass ctor's initial scan
+    watcher._seen = set()
+    assert [p.name for p in watcher.poll()] == ["stays"]
+    assert [p.name for p, _ in seed_paths([tmp_path])] == ["stays"]
+    monkeypatch.undo()
+    ghost.unlink()

@@ -539,3 +539,34 @@ def test_telemetry_report_summarizes_campaign(tmp_path, capsys):
     assert telemetry_report.main([str(telem)]) == 0
     assert "phases" in capsys.readouterr().out
     assert telemetry_report.main([]) == 1
+
+
+def test_telemetry_report_host_device_wall_breakdown(tmp_path):
+    """ISSUE 6 satellite: the report splits each top-level phase into
+    host-busy vs device-busy wall-clock from the fenced nested spans —
+    the artifact that makes the devmut double-buffer overlap claim
+    directly measurable.  With mutate-on-device, mutate's host share is
+    its total minus the nested mutate/device fence."""
+    import telemetry_report
+
+    path = tmp_path / "events.jsonl"
+    reg = Registry()
+    sec = reg.counter("phase.seconds")
+    sec.labels("mutate").inc(2.0)
+    sec.labels("mutate/device").inc(1.9)          # fenced generation wait
+    sec.labels("execute").inc(10.0)
+    sec.labels("execute/device-step").inc(7.0)
+    sec.labels("execute/insert/device").inc(1.0)  # fused insert wait
+    sec.labels("execute/service-pull").inc(2.0)   # host servicing
+    sec.labels("harvest").inc(0.5)
+    with EventLog(path) as log:
+        log.emit("run-start")
+        log.emit("run-end", metrics=reg.dump())
+    wb = telemetry_report.summarize(path)["wall_breakdown"]
+    assert wb["by_phase"]["mutate"]["device_seconds"] == 1.9
+    assert round(wb["by_phase"]["mutate"]["host_seconds"], 4) == 0.1
+    assert wb["by_phase"]["execute"]["device_seconds"] == 8.0
+    assert wb["by_phase"]["execute"]["host_seconds"] == 2.0
+    assert wb["by_phase"]["harvest"]["device_seconds"] == 0.0
+    assert round(wb["host_busy_seconds"], 4) == 2.6
+    assert round(wb["device_busy_seconds"], 4) == 9.9

@@ -25,6 +25,46 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from wtf_tpu.telemetry.events import read_events  # noqa: E402
 
+# Span leaves that measure DEVICE work (each is fenced with
+# jax.block_until_ready before its span closes): the device-step/
+# pallas-step executors, the fused devmut generation+insert waits
+# ("device" under mutate/insert), the overlay restore, and the coverage
+# readback.  Everything else inside a top-level phase is host time.
+DEVICE_SPAN_LEAVES = frozenset((
+    "device", "device-step", "pallas-step", "overlay-restore",
+    "cov-readback",
+))
+
+
+def wall_breakdown(phase_seconds: dict) -> dict:
+    """Host-busy vs device-busy split of the top-level phases: for each
+    phase, device seconds = the fenced device spans nested under it,
+    host seconds = the remainder.  This is what makes the devmut
+    double-buffer claim measurable from an events.jsonl — with
+    mutate-on-device, `mutate.host_seconds` collapses to dispatch
+    overhead and the generation wait shows under mutate/device."""
+    top = {name: secs for name, secs in phase_seconds.items()
+           if "/" not in name}
+    device_by_top: dict = {}
+    for path, secs in phase_seconds.items():
+        parts = path.split("/")
+        if len(parts) > 1 and parts[-1] in DEVICE_SPAN_LEAVES:
+            device_by_top[parts[0]] = device_by_top.get(parts[0], 0.0) + secs
+    by_phase = {}
+    host_total = device_total = 0.0
+    for name, secs in sorted(top.items(), key=lambda kv: -kv[1]):
+        dev = min(device_by_top.get(name, 0.0), secs)
+        by_phase[name] = {"seconds": round(secs, 4),
+                          "device_seconds": round(dev, 4),
+                          "host_seconds": round(secs - dev, 4)}
+        host_total += secs - dev
+        device_total += dev
+    return {
+        "host_busy_seconds": round(host_total, 4),
+        "device_busy_seconds": round(device_total, 4),
+        "by_phase": by_phase,
+    }
+
 
 def summarize(path) -> dict:
     """Machine-readable summary of one events.jsonl."""
@@ -93,6 +133,7 @@ def summarize(path) -> dict:
     nested = {name: round(secs, 4)
               for name, secs in sorted(phase_seconds.items())
               if "/" in name}
+    breakdown = wall_breakdown(phase_seconds)
 
     testcases = metrics.get("campaign.testcases", 0) or 0
     fallbacks = metrics.get("runner.fallbacks_by_opclass", {})
@@ -116,6 +157,7 @@ def summarize(path) -> dict:
         "phases": phases,
         "phase_accounted_frac": round(top_total / wall, 4) if wall else None,
         "nested_phases": nested,
+        "wall_breakdown": breakdown,
         "testcases": testcases,
         "testcases_per_s": round(testcases / wall, 2) if wall else None,
         "compiles": {"total": sum(compiles_by_shape.values()),
@@ -170,6 +212,14 @@ def _print_human(s: dict) -> None:
             print(f"  {name:<16} {d['seconds']:>10.3f}s{share}")
         for name, secs in s["nested_phases"].items():
             print(f"    {name:<24} {secs:>8.3f}s")
+    wb = s.get("wall_breakdown") or {}
+    if wb.get("by_phase"):
+        print(f"host-busy vs device-busy: "
+              f"{wb['host_busy_seconds']}s host / "
+              f"{wb['device_busy_seconds']}s device")
+        for name, d in wb["by_phase"].items():
+            print(f"  {name:<16} host {d['host_seconds']:>9.3f}s  "
+                  f"device {d['device_seconds']:>9.3f}s")
     print(f"testcases: {s['testcases']}"
           + (f" ({s['testcases_per_s']}/s)" if s["testcases_per_s"] else ""))
     if s["compiles"]["total"]:

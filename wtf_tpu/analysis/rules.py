@@ -116,10 +116,12 @@ def check_seam_bitcast_only(fn, *args, entry: str) -> List[Finding]:
 
 def _dtype_arg_recipes() -> Dict[str, Tuple]:
     """name -> (fn, args) for every ported path the dtype family pins.
-    The step-level names must cover step.PORTED_LIMB_PATHS exactly;
-    run_dtype_family fails the lint on any export without a recipe."""
+    The step-level names must cover step.PORTED_LIMB_PATHS (and the
+    devmut engine's devmut.PORTED_LIMB_PATHS) exactly; run_dtype_family
+    fails the lint on any export without a recipe."""
     import jax.numpy as jnp
 
+    from wtf_tpu.devmut import engine as DM
     from wtf_tpu.interp import limbs as L
     from wtf_tpu.interp import step as S
     from wtf_tpu.interp.uoptable import UopTable
@@ -175,6 +177,23 @@ def _dtype_arg_recipes() -> Dict[str, Tuple]:
         "step.gpr_write_l": (S._gpr_write_l,
                              (gl, jnp.bool_(True), jnp.int32(3), p, n4)),
     }
+    # devmut engine paths (devmut.PORTED_LIMB_PATHS): tiny shapes — the
+    # pin is about dtypes, not scale
+    dm_data = jnp.zeros((4, 8), jnp.uint32)
+    dm_lens = jnp.ones((4,), jnp.int32)
+    dm_cumw = jnp.asarray([1, 2, 3, 4], jnp.uint32)
+    dm_seeds = jnp.zeros((2, 2), jnp.uint32)
+    recipes.update({
+        "devmut.prng_next": (DM.prng_next, (p,)),
+        "devmut.pick_slot": (DM.pick_slot,
+                             (dm_cumw, jnp.asarray([5, 7], jnp.uint32))),
+        "devmut.unpack_bytes": (DM.unpack_bytes, (dm_data,)),
+        "devmut.pack_words": (DM.pack_words,
+                              (jnp.zeros((2, 32), jnp.uint32),)),
+        "devmut.generate": (
+            lambda d, ln, c, s: DM.generate(d, ln, c, s, rounds=1),
+            (dm_data, dm_lens, dm_cumw, dm_seeds)),
+    })
     return recipes
 
 
@@ -189,11 +208,12 @@ def run_dtype_family(exports: Optional[Dict] = None,
     don't need them)."""
     import jax.numpy as jnp
 
+    from wtf_tpu.devmut import engine as DM
     from wtf_tpu.interp import limbs as L
     from wtf_tpu.interp import step as S
 
     if exports is None:
-        exports = S.PORTED_LIMB_PATHS
+        exports = {**S.PORTED_LIMB_PATHS, **DM.PORTED_LIMB_PATHS}
     recipes = _dtype_arg_recipes()
     findings: List[Finding] = []
     for name in sorted(exports):

@@ -83,6 +83,40 @@ def timed_chunk(runner, limit: int = 1 << 40) -> dict:
             "instr": int((np.asarray(m2.icount) - ic0).sum())}
 
 
+def build_tlv_campaign(n_lanes: int = 64, mutator: str = "mangle",
+                       limit: int = 100_000, seed: int = 0x77F,
+                       max_len: int = 0x400, registry=None,
+                       **backend_kwargs):
+    """A demo_tlv FuzzLoop ready to run_one_batch(): tpu backend built
+    and initialized, target init, one TLV seed in the corpus, and the
+    mutation engine picked by name ("mangle" = best host engine;
+    "devmangle" = the device-resident engine, wtf_tpu/devmut) — the
+    A/B harness `ablate.py devmut` and the devmut tests share."""
+    import random
+
+    from wtf_tpu.backend import create_backend
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+    from wtf_tpu.fuzz.mutator import create_mutator
+    from wtf_tpu.fuzz.native_mutator import best_mangle_mutator
+    from wtf_tpu.harness import demo_tlv
+    from wtf_tpu.telemetry import Registry
+
+    registry = registry if registry is not None else Registry()
+    backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                             n_lanes=n_lanes, limit=limit,
+                             registry=registry, **backend_kwargs)
+    backend.initialize()
+    demo_tlv.TARGET.init(backend)
+    rng = random.Random(seed)
+    corpus = Corpus(rng=rng)
+    corpus.add(b"\x01\x04AAAA\x02\x08BBBBBBBB")
+    mut = (best_mangle_mutator(rng, max_len) if mutator == "mangle"
+           else create_mutator(mutator, rng, max_len))
+    return FuzzLoop(backend, demo_tlv.TARGET, mut, corpus,
+                    registry=registry)
+
+
 # ---------------------------------------------------------------------------
 # HLO / StableHLO capture for the rule engine
 # ---------------------------------------------------------------------------

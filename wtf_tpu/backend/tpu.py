@@ -169,11 +169,18 @@ class TpuBackend(Backend):
             runner.push(view)
             self._view = None
         statuses = runner.run(bp_handler=self._dispatch_bp)
+        self._finish_batch(statuses, n_active)
+        return [self._map_result(lane, statuses[lane])
+                for lane in range(n_active)]
 
-        # coverage merge on device (timeouts revoked like the reference
-        # client, and OVERLAY_FULL lanes excluded — they ran on truncated
-        # memory, their coverage is not trustworthy)
-        with spans.span("cov-readback") as sp:
+    def _finish_batch(self, statuses, n_active: int) -> None:
+        """Post-run batch accounting shared by run_batch and
+        run_batch_device: coverage merge on device (timeouts revoked like
+        the reference client, and OVERLAY_FULL lanes excluded — they ran
+        on truncated memory, their coverage is not trustworthy), backend
+        counters, and the once-per-burst device-counter fold."""
+        runner = self.runner
+        with self.registry.spans.span("cov-readback") as sp:
             m = runner.machine
             include = jnp.asarray(
                 (statuses != int(StatusCode.TIMEDOUT))
@@ -192,8 +199,35 @@ class TpuBackend(Backend):
             runner.fold_device_counters()
             sp.fence(self._agg_cov)
 
+    def run_batch_device(self, mutator, target) -> List[TestcaseResult]:
+        """One batch whose testcases were generated ON DEVICE (wtf_tpu/
+        devmut): insertion is a single in-graph overlay/register update
+        (Runner.device_insert) instead of per-lane target.insert_testcase
+        calls — mutate→insert→execute with no host round-trip for the
+        testcase bytes.  `mutator` is a bound DevMangleMutator whose
+        take_batch() already ran; every lane is active."""
+        runner = self.runner
+        runner.limit = self.limit
+        self._lane_results = {}
+        spans = self.registry.spans
+        with spans.span("insert"):
+            # host state staged through the backend view (e.g. init-time
+            # register/memory writes a target made before the first
+            # batch) must land, exactly as run_batch's push does —
+            # BEFORE device_insert so the testcase wins any overlap
+            if self._view is not None:
+                runner.push(self._view)
+                self._view = None
+            with spans.span("device") as sp:
+                words, lens = mutator.current_batch()
+                spec = mutator.spec
+                runner.device_insert(words, lens, mutator.pfns, spec.gva,
+                                     spec.len_gpr, spec.ptr_gpr)
+                sp.fence(runner.machine.status)
+        statuses = runner.run(bp_handler=self._dispatch_bp)
+        self._finish_batch(statuses, self.n_lanes)
         return [self._map_result(lane, statuses[lane])
-                for lane in range(n_active)]
+                for lane in range(self.n_lanes)]
 
     def lane_found_new_coverage(self, lane: int) -> bool:
         return bool(self._new_lane[lane])

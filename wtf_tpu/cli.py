@@ -158,6 +158,16 @@ def build_parser() -> argparse.ArgumentParser:
     camp.add_argument("--max_len", type=int, default=1024 * 1024)
     camp.add_argument("--seed", type=int, default=0)
     camp.add_argument("--lanes", type=int, default=64)
+    camp.add_argument("--mutator",
+                      choices=("auto", "byte", "mangle", "tlv", "devmangle"),
+                      default="auto",
+                      help="mutation engine: auto = the target's custom "
+                           "mutator, else the best host mangle engine. "
+                           "devmangle = the device-resident engine "
+                           "(wtf_tpu/devmut): the whole batch is "
+                           "generated in-graph from the HBM corpus slab "
+                           "(tpu backend + a target with a "
+                           "DeviceInsertSpec only)")
     camp.add_argument("--stop-on-crash", action="store_true")
     camp.add_argument("--coordinator", default=None,
                       help="jax.distributed coordinator address for a"
@@ -366,7 +376,7 @@ def cmd_campaign(args) -> int:
     opts = CampaignOptions(name=args.name, backend=args.backend,
                            limit=args.limit, runs=args.runs,
                            max_len=args.max_len, seed=args.seed,
-                           lanes=args.lanes,
+                           lanes=args.lanes, mutator=args.mutator,
                            stop_on_crash=args.stop_on_crash,
                            paths=_paths_from(args))
     if args.coordinator or args.num_processes:
@@ -399,8 +409,12 @@ def cmd_campaign(args) -> int:
                                      outputs_dir=opts.paths.outputs)
         else:
             corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
-        loop = FuzzLoop(backend, target,
-                        _mutator_for(target, rng, opts.max_len),
+        from wtf_tpu.fuzz.mutator import create_mutator
+
+        mutator = (_mutator_for(target, rng, opts.max_len)
+                   if opts.mutator == "auto"
+                   else create_mutator(opts.mutator, rng, opts.max_len))
+        loop = FuzzLoop(backend, target, mutator,
                         corpus, crashes_dir=opts.paths.crashes,
                         registry=registry, events=events)
         if opts.runs == 0:

@@ -90,5 +90,6 @@ class CampaignOptions:
     max_len: int = 1024 * 1024
     seed: int = 0
     lanes: int = 64
+    mutator: str = "auto"   # auto | byte | mangle | tlv | devmangle
     stop_on_crash: bool = False
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)

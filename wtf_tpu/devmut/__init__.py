@@ -1,0 +1,31 @@
+"""Device-resident mutation engine: the testcase stream lives in HBM.
+
+ROADMAP item 3's mutate-on-device leg.  The host mutate->insert phase
+was the serialization point of every batch (PR 3's phase spans put it
+squarely on the host); this package moves the mangle-class mutators
+in-graph so `mutate -> insert -> execute` is one device program per
+batch and the host touches testcase bytes only for crashes, new
+coverage, and corpus I/O:
+
+  corpus.py   DeviceCorpus — the [slots, max_len/4] u32 HBM seed slab
+              with per-slot lengths and favor weights
+  engine.py   the vectorized u32 mangle engine (per-lane splitmix64
+              streams on interp/limbs.py; 8-op honggfuzz-class table);
+              exports PORTED_LIMB_PATHS so `wtf-tpu lint` pins it
+              u64/f64-free like the step's ported paths
+  hostref.py  the authoritative jax-free op spec + bit-exact host
+              mirror the property tests compare against
+  mutator.py  DevMangleMutator — the `devmangle` fuzz.mutator engine,
+              double-buffered so generation of batch N+1 overlaps
+              host harvest of batch N
+
+The insert seam lives in interp/runner.py (`Runner.device_insert`) and
+the batch driver in backend/tpu.py (`run_batch_device`) /
+fuzz/loop.py (`FuzzLoop` device path).
+"""
+
+from wtf_tpu.devmut.corpus import DeviceCorpus  # noqa: F401
+from wtf_tpu.devmut.hostref import (  # noqa: F401
+    FAVOR_WEIGHT, N_OPS, OP_NAMES, host_generate, lane_seeds,
+)
+from wtf_tpu.devmut.mutator import DevMangleMutator  # noqa: F401

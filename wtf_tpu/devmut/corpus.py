@@ -1,0 +1,97 @@
+"""DeviceCorpus: the HBM-resident seed slab the devmangle engine reads.
+
+Host-managed, device-consumed: the host keeps the authoritative numpy
+slab (`[slots, max_len/4]` u32 words, per-slot byte lengths and favor
+weights) and uploads it lazily — `arrays()` returns the cached device
+triple and re-uploads only after a mutating `add`.  The engine never
+reads testcase bytes back; the slab is write-mostly from the host's
+perspective (one upload per harvest round that found something) and
+read-every-batch from the device's.
+
+Slot policy: fill empty slots first; when full, evict the lowest-weight
+slot (first index on ties) — coverage-increasing finds enter with
+`hostref.FAVOR_WEIGHT`, plain seeds with weight 1, so favored testcases
+both survive eviction longer AND are drawn proportionally more often by
+the engine's cumulative-weight pick.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from wtf_tpu.utils.hashing import hex_digest
+
+
+class DeviceCorpus:
+    def __init__(self, slots: int, max_len: int):
+        if max_len < 4:
+            raise ValueError("devmut max_len must be >= 4 bytes")
+        self.slots = slots
+        self.max_len = max_len
+        self.words = (max_len + 3) // 4
+        self._data = np.zeros((slots, self.words), dtype=np.uint32)
+        self._len = np.zeros((slots,), dtype=np.int32)
+        self._weight = np.zeros((slots,), dtype=np.uint32)
+        self._slot_of: Dict[str, int] = {}   # digest -> slot
+        self._digest_of: Dict[int, str] = {}
+        self.count = 0
+        self._dirty = True
+        self._dev: Optional[Tuple] = None
+
+    def __len__(self) -> int:
+        return self.count
+
+    def add(self, data: bytes, weight: int = 1) -> bool:
+        """Insert a testcase (truncated to max_len, zero-padded into its
+        slot).  Returns False for empties and content duplicates —
+        a duplicate re-add BUMPS the existing slot to max(old, weight)
+        so a favored re-find upgrades its seed."""
+        data = data[:self.max_len]
+        if not data:
+            return False
+        digest = hex_digest(data)
+        slot = self._slot_of.get(digest)
+        if slot is not None:
+            if weight > self._weight[slot]:
+                self._weight[slot] = weight
+                self._dirty = True
+            return False
+        if self.count < self.slots:
+            slot = self.count
+            self.count += 1
+        else:
+            slot = int(np.argmin(self._weight))
+            self._slot_of.pop(self._digest_of.pop(slot, ""), None)
+        buf = np.zeros(self.words * 4, dtype=np.uint8)
+        buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+        self._data[slot] = buf.view(np.uint32)
+        self._len[slot] = len(data)
+        self._weight[slot] = max(weight, 1)
+        self._slot_of[digest] = slot
+        self._digest_of[slot] = digest
+        self._dirty = True
+        return True
+
+    def cumulative_weights(self) -> np.ndarray:
+        """Inclusive cumulative favor weights (the engine's pick table).
+        u32 by contract: weights are small ints, so the total cannot
+        approach 2^32 at any plausible slot count."""
+        cum = np.cumsum(self._weight, dtype=np.uint64)
+        assert cum[-1] < (1 << 32), "favor-weight total overflows u32"
+        return cum.astype(np.uint32)
+
+    def arrays(self) -> Tuple:
+        """(data, lens, cumw) as device arrays; re-uploads only when a
+        host-side add dirtied the slab.  Returns a 4th element `synced`
+        telling the caller whether this call paid an upload."""
+        synced = False
+        if self._dirty or self._dev is None:
+            import jax.numpy as jnp
+
+            self._dev = (jnp.asarray(self._data), jnp.asarray(self._len),
+                         jnp.asarray(self.cumulative_weights()))
+            self._dirty = False
+            synced = True
+        return (*self._dev, synced)

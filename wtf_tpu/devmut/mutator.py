@@ -1,0 +1,183 @@
+"""DevMangleMutator: the `devmangle` engine behind the Mutator contract.
+
+Where every other engine's `get_new_testcase` returns host bytes for the
+backend to insert lane-by-lane, this one generates the WHOLE batch on
+device (devmut/engine.py) and hands the batched `[lanes, words]` u32
+array straight to the Runner's fused insert seam — the testcase stream
+never leaves HBM.  Host code only ever pulls the few lanes the harvest
+actually wants (crashes, new coverage) via `fetch`.
+
+Double buffering: `prelaunch()` dispatches generation of batch N+1
+(async, device-queue only) while the host is still harvesting batch N;
+`take_batch()` then just swaps it in, so the campaign's `mutate` phase
+shrinks to a fence on already-finished work.  The corpus a prelaunched
+batch samples is the slab as of batch N-1's harvest — the standard
+one-batch lag of a pipelined generator.
+
+Determinism: the whole stream is a pure function of (campaign seed,
+batch index, lane) via hostref.lane_seeds, and slab evolution is
+host-ordered — a seeded `--mutator devmangle` campaign replays exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from wtf_tpu.devmut import hostref
+from wtf_tpu.devmut.corpus import DeviceCorpus
+from wtf_tpu.fuzz.mutator import Mutator
+from wtf_tpu.telemetry import NULL, Registry, StatsDict
+
+# generator executor shapes dispatched at least once in this process —
+# compile events fire exactly when jit actually compiles (same contract
+# as interp.runner._DISPATCHED_EXECUTORS)
+_DISPATCHED_GEN = set()
+
+
+class DevMangleMutator(Mutator):
+    """Device-resident mangle engine (fuzz.mutator name: "devmangle")."""
+
+    is_device = True
+
+    def __init__(self, seed: int, max_len: int, rounds: int = 5,
+                 slots: int = 256):
+        self.seed = seed & ((1 << 64) - 1)
+        self.max_len = max_len
+        self.rounds = rounds
+        self.slots = slots
+        self.corpus: Optional[DeviceCorpus] = None
+        self.spec = None
+        self.pfns: List[int] = []
+        self.n_lanes = 0
+        self._batch = 0
+        self._pending: Optional[Tuple] = None
+        self._current: Optional[Tuple] = None
+        self.registry: Registry = Registry()
+        self.events = NULL
+        self.stats: Optional[StatsDict] = None
+
+    # -- device binding ----------------------------------------------------
+    def bind(self, backend, target, registry: Optional[Registry] = None,
+             events=None) -> None:
+        """Attach to the batched backend + target insert spec.  Called by
+        FuzzLoop before the first batch; raises early (with the fix) for
+        backends/targets that can't run the device path."""
+        spec = getattr(target, "device_insert", None)
+        if spec is None:
+            raise ValueError(
+                f"target {getattr(target, 'name', target)!r} has no "
+                "device_insert spec — devmangle needs the declarative "
+                "insert seam (harness.targets.DeviceInsertSpec)")
+        runner = getattr(backend, "runner", None)
+        if runner is None or not hasattr(backend, "run_batch_device"):
+            raise ValueError(
+                "devmangle requires the batched tpu backend "
+                "(--backend=tpu); host backends have no device to "
+                "generate on")
+        from wtf_tpu import telemetry
+
+        self.registry, self.events = telemetry.resolve(
+            backend, registry, events)
+        self.stats = StatsDict(
+            self.registry, "devmut",
+            fields=("batches", "generated", "fetched", "corpus_syncs"),
+            gauges=("corpus_slots",))
+        self.max_len = min(self.max_len, spec.max_len)
+        self.corpus = DeviceCorpus(self.slots, self.max_len)
+        self.spec = spec
+        self.runner = runner
+        self.n_lanes = runner.n_lanes
+        # input-region pfns through lane 0's page tables — the snapshot
+        # mapping is static, so translate ONCE at bind time and the
+        # insert seam never page-walks again
+        page = 4096
+        n_pages = (self.max_len + page - 1) // page
+        view = runner.view()
+        self.pfns = [view.translate(0, spec.gva + i * page) >> 12
+                     for i in range(n_pages)]
+
+    def seed_from(self, corpus) -> int:
+        """Load a host Corpus' testcases into the device slab (campaign
+        startup: inputs/ seeds).  Returns how many entered."""
+        n = 0
+        for data in corpus:
+            n += bool(self.corpus.add(data))
+        return n
+
+    # -- batch generation --------------------------------------------------
+    def _dispatch(self) -> Tuple:
+        from wtf_tpu.devmut.engine import make_generate
+        import jax.numpy as jnp
+
+        data, lens, cumw, synced = self.corpus.arrays()
+        if synced:
+            self.stats["corpus_syncs"] += 1
+        self.stats["corpus_slots"] = len(self.corpus)
+        seeds = jnp.asarray(
+            hostref.lane_seeds(self.seed, self._batch, self.n_lanes))
+        key = (self.rounds, data.shape, seeds.shape)
+        if key not in _DISPATCHED_GEN:
+            _DISPATCHED_GEN.add(key)
+            self.events.emit("compile", kind="devmut-gen",
+                             rounds=self.rounds, slots=data.shape[0],
+                             words=data.shape[1], lanes=self.n_lanes)
+        out = make_generate(self.rounds)(data, lens, cumw, seeds)
+        self._batch += 1
+        self.stats["batches"] += 1
+        self.stats["generated"] += self.n_lanes
+        return out
+
+    def prelaunch(self) -> None:
+        """Dispatch generation of the NEXT batch onto the device queue
+        (async; no host sync) — the double-buffer half that overlaps
+        device generation with host harvest."""
+        if self._pending is None:
+            self._pending = self._dispatch()
+
+    def take_batch(self) -> Tuple:
+        """The batch to execute now: the prelaunched one when present
+        (first batch, or after a corpus reseed, it dispatches inline).
+        Returns (words u32[L, W], lens i32[L]) device arrays."""
+        if self._pending is None:
+            self._pending = self._dispatch()
+        self._current, self._pending = self._pending, None
+        return self._current
+
+    def current_batch(self) -> Tuple:
+        """The batch taken for execution (what the insert seam writes)."""
+        if self._current is None:
+            raise RuntimeError("no device batch taken yet "
+                               "(call take_batch first)")
+        return self._current
+
+    # -- host harvest seam -------------------------------------------------
+    def fetch(self, lanes: Sequence[int]) -> Dict[int, bytes]:
+        """Pull the generated bytes of just `lanes` to the host (crash
+        saving / corpus insertion) — the only point where testcase bytes
+        leave HBM."""
+        if not lanes:
+            return {}
+        import jax
+
+        words, lens = self.current_batch()
+        lens_h = np.asarray(jax.device_get(lens))
+        # ONE gather + ONE transfer for all wanted lanes — per-lane
+        # device_get would cost len(lanes) round trips, and early
+        # batches mark nearly every lane as new coverage
+        lane_arr = np.asarray(list(lanes), dtype=np.int32)
+        rows = np.asarray(jax.device_get(words[lane_arr]))
+        out = {int(lane): rows[j].tobytes()[:int(lens_h[lane])]
+               for j, lane in enumerate(lane_arr)}
+        self.stats["fetched"] += len(lanes)
+        return out
+
+    # -- Mutator contract --------------------------------------------------
+    def on_new_coverage(self, testcase: bytes) -> None:
+        self.corpus.add(testcase, weight=hostref.FAVOR_WEIGHT)
+
+    def get_new_testcase(self, corpus) -> bytes:
+        raise RuntimeError(
+            "devmangle generates whole batches on device; drive it "
+            "through FuzzLoop's device path (or pick a host engine)")

@@ -156,6 +156,15 @@ class FuzzLoop:
         # under this loop's execute phase and one dump carries everything
         self.registry, self.events = telemetry.resolve(
             backend, registry, events)
+        # device-resident mutation engine (wtf_tpu/devmut): the whole
+        # mutate->insert phase moves in-graph and batches run through
+        # _run_one_batch_device.  bind() raises early for backends or
+        # targets that can't take the device path.
+        self.mutate_on_device = bool(getattr(mutator, "is_device", False))
+        if self.mutate_on_device:
+            mutator.bind(backend, target, registry=self.registry,
+                         events=self.events)
+            mutator.seed_from(corpus)
         self.stats = CampaignStats(self.registry)
         self.stats_every = stats_every
         self.crash_names = set()
@@ -181,8 +190,36 @@ class FuzzLoop:
         self._save_crash(data, result)
         return 1
 
+    def _harvest_lane(self, lane: int, data: bytes, result: TestcaseResult,
+                      requeue: bool = False) -> int:
+        """The ONE per-lane harvest body shared by the host and device
+        batch paths: result accounting (+ optional overlay-full requeue)
+        and the new-coverage -> corpus/mutator/event chain.  Returns 1
+        for a crash."""
+        crashes = self._account(data, result, requeue=requeue)
+        if self.backend.lane_found_new_coverage(lane):
+            self.stats.new_coverage += 1
+            if self.corpus.add(data):
+                self.mutator.on_new_coverage(data)
+                self.events.emit("new-coverage", digest=hex_digest(data),
+                                 size=len(data))
+        return crashes
+
+    def _emit_timeouts(self, timeouts_before: int) -> None:
+        """Aggregated: one record per batch, not one per timed-out lane."""
+        timeouts = self.stats.timeouts - timeouts_before
+        if timeouts:
+            self.events.emit("timeout", count=timeouts)
+
+    def _restore_batch(self) -> None:
+        with self.registry.spans.span("restore"):
+            self.target.restore()
+            self.backend.restore()
+
     def run_one_batch(self) -> int:
         """Returns the number of crashes found in this batch."""
+        if self.mutate_on_device:
+            return self._run_one_batch_device()
         spans = self.registry.spans
         with spans.span("mutate"):
             requeued, self._requeue = \
@@ -202,21 +239,45 @@ class FuzzLoop:
         timeouts_before = self.stats.timeouts
         with spans.span("harvest"):
             for lane, (data, result) in enumerate(zip(testcases, results)):
-                crashes += self._account(data, result, requeue=True)
-                if self.backend.lane_found_new_coverage(lane):
-                    self.stats.new_coverage += 1
-                    if self.corpus.add(data):
-                        self.mutator.on_new_coverage(data)
-                        self.events.emit("new-coverage",
-                                         digest=hex_digest(data),
-                                         size=len(data))
-        timeouts = self.stats.timeouts - timeouts_before
-        if timeouts:
-            # aggregated: one record per batch, not one per timed-out lane
-            self.events.emit("timeout", count=timeouts)
-        with spans.span("restore"):
-            self.target.restore()
-            self.backend.restore()
+                crashes += self._harvest_lane(lane, data, result,
+                                              requeue=True)
+        self._emit_timeouts(timeouts_before)
+        self._restore_batch()
+        return crashes
+
+    def _run_one_batch_device(self) -> int:
+        """The devmangle batch: generation + insertion are device
+        programs, so `mutate`'s HOST share is dispatch overhead and the
+        device wait is measured under the nested `mutate/device` span.
+        Double-buffered: batch N+1's generation is prelaunched at the top
+        of N's harvest, so by N+1's mutate fence the work has been
+        overlapping host-side harvest/restore/heartbeat wall-clock (the
+        slab it samples is as of N-1's finds — the one-batch lag of a
+        pipelined generator).  Host code only pulls the lanes the
+        harvest wants (crashes, new coverage); overlay-full requeue does
+        not apply — the stream has no host bytes to requeue."""
+        spans = self.registry.spans
+        with spans.span("mutate"):
+            with spans.span("device") as sp:
+                _, lens = self.mutator.take_batch()
+                sp.fence(lens)
+        with spans.span("execute"):
+            results = self.backend.run_batch_device(self.mutator,
+                                                    self.target)
+        crashes = 0
+        timeouts_before = self.stats.timeouts
+        with spans.span("harvest"):
+            # double-buffer: batch N+1 generates while we harvest batch N
+            self.mutator.prelaunch()
+            wanted = [lane for lane, result in enumerate(results)
+                      if self.backend.lane_found_new_coverage(lane)
+                      or isinstance(result, Crash)]
+            datas = self.mutator.fetch(wanted)
+            for lane, result in enumerate(results):
+                crashes += self._harvest_lane(lane, datas.get(lane, b""),
+                                              result)
+        self._emit_timeouts(timeouts_before)
+        self._restore_batch()
         return crashes
 
     def _save_crash(self, data: bytes, result: Crash) -> None:
@@ -258,9 +319,7 @@ class FuzzLoop:
                     if self.backend.lane_found_new_coverage(lane):
                         self.stats.new_coverage += 1
                         kept.add(data)
-            with spans.span("restore"):
-                self.target.restore()
-                self.backend.restore()
+            self._restore_batch()
             self._heartbeat(print_stats)
         return kept
 

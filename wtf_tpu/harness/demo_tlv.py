@@ -25,7 +25,7 @@ toolchain (source in _GUEST_ASM for auditability/regeneration).
 from __future__ import annotations
 
 from wtf_tpu.core.results import Ok
-from wtf_tpu.harness.targets import Target
+from wtf_tpu.harness.targets import DeviceInsertSpec, Target
 from wtf_tpu.snapshot.loader import Snapshot
 from wtf_tpu.snapshot.synthetic import SyntheticSnapshotBuilder
 
@@ -124,4 +124,8 @@ TARGET = Target(
     insert_testcase=_insert_testcase,
     create_mutator=_create_mutator,
     snapshot=build_snapshot,
+    # declarative twin of _insert_testcase for the device-resident
+    # mutation path: bytes at INPUT_GVA, pointer in rsi (6), len in rdx (2)
+    device_insert=DeviceInsertSpec(gva=INPUT_GVA, max_len=MAX_INPUT,
+                                   len_gpr=2, ptr_gpr=6),
 )

@@ -18,6 +18,13 @@ bundle of callbacks —
                                  contained synthetic targets (the reference
                                  loads user-supplied crash dumps instead,
                                  wtf.cc:127-129)
+  device_insert                  optional DeviceInsertSpec: the declarative
+                                 equivalent of insert_testcase for the
+                                 device-resident mutation path (wtf_tpu/
+                                 devmut) — where the bytes land and which
+                                 registers carry pointer/length, so the
+                                 whole insertion can be one in-graph
+                                 overlay/register update per batch
 
 Constructing a Target self-registers it (reference targets.cc:11-22); the
 CLI looks targets up by --name (wtf.cc:378-383).
@@ -29,6 +36,21 @@ import dataclasses
 from typing import Callable, Dict, Optional
 
 
+@dataclasses.dataclass(frozen=True)
+class DeviceInsertSpec:
+    """Declarative testcase-insertion contract for targets whose
+    insert_testcase is "write the bytes at a fixed GVA, put the pointer
+    and length in registers" (the fuzzer_hevd.cc:20-59 shape).  The
+    devmangle path (wtf_tpu/devmut) uses it to fuse insertion into the
+    device program; the imperative insert_testcase remains the host
+    path's contract and MUST stay semantically equivalent."""
+
+    gva: int                 # where testcase bytes land (page-aligned)
+    max_len: int             # region capacity in bytes
+    len_gpr: int = 2         # GPR index receiving the byte length (rdx)
+    ptr_gpr: int = 6         # GPR index receiving the buffer GVA (rsi)
+
+
 @dataclasses.dataclass
 class Target:
     name: str
@@ -37,6 +59,7 @@ class Target:
     restore: Callable = lambda: True
     create_mutator: Optional[Callable] = None
     snapshot: Optional[Callable] = None
+    device_insert: Optional[DeviceInsertSpec] = None
 
     def __post_init__(self):
         Targets.instance().register(self)

@@ -22,7 +22,7 @@ all memory writes buffered host-side and applied in a single jitted scan
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import jax
@@ -497,6 +497,73 @@ _apply_page_writes_donated = partial(
 _apply_page_writes_plain = jax.jit(_apply_page_writes)
 
 
+@lru_cache(maxsize=None)
+def _make_device_insert(n_pages: int, n_words: int, len_gpr: int,
+                        ptr_gpr: int, donate: bool):
+    """The fused insert seam for device-generated testcases (wtf_tpu/
+    devmut): one in-graph update that lands a whole batch's bytes in the
+    per-lane overlay and sets the target ABI registers — the
+    mutate-on-device replacement for per-lane target.insert_testcase.
+
+    Claims n_pages FRESH overlay slots per lane starting at the lane's
+    current count, so rows the preceding host push allocated (init-time
+    target writes to pages OUTSIDE the insert region) survive.  Any
+    existing row already holding an insert-region pfn is retired first
+    (pfn -> -1): the testcase must win, and a duplicate-pfn row would
+    shadow the new one (overlay lookup takes the FIRST match).  A lane
+    without n_pages free slots surfaces as OVERLAY_FULL, exactly like
+    the host page-write path.  The u32 word stream bitcasts to the
+    overlay's u64 words at the pack seam; rows are fully valid (bytes
+    past the testcase length are zero by the engine's padded-slab
+    contract, so page contents are deterministic)."""
+    pad = n_pages * (PAGE_SIZE // 4) - n_words
+    assert pad >= 0, "testcase words exceed the insert region"
+
+    def impl(machine: Machine, words, lens, pfns, gva_l):
+        n_lanes = machine.status.shape[0]
+        w = jnp.pad(words, ((0, 0), (0, pad))) if pad else words
+        rows = limbs.pack_u64(
+            w.reshape(n_lanes, n_pages, PAGE_SIZE // 8, 2))
+        ov = machine.overlay
+        capacity = ov.pfn.shape[1]
+        # retire rows already holding an insert-region pfn (a pushed
+        # host write into the input region; slot leaks until restore)
+        dead = (ov.pfn[:, :, None] == pfns[None, None, :]).any(-1)
+        pfn0 = jnp.where(dead, jnp.int32(-1), ov.pfn)
+        start = ov.count                                   # i32[L]
+        ok = start + jnp.int32(n_pages) <= jnp.int32(capacity)
+        li = lax.broadcasted_iota(jnp.int32, (n_lanes, n_pages), 0)
+        ridx = jnp.minimum(start[:, None]
+                           + lax.broadcasted_iota(
+                               jnp.int32, (n_lanes, n_pages), 1),
+                           jnp.int32(capacity - 1))
+        sel = ok[:, None]
+        overlay = ov._replace(
+            data=ov.data.at[li, ridx].set(
+                jnp.where(sel[..., None], rows, ov.data[li, ridx])),
+            valid=ov.valid.at[li, ridx].set(
+                jnp.where(sel[..., None], jnp.uint8(1),
+                          ov.valid[li, ridx])),
+            pfn=pfn0.at[li, ridx].set(
+                jnp.where(sel, jnp.broadcast_to(pfns, (n_lanes, n_pages)),
+                          pfn0[li, ridx])),
+            count=jnp.where(ok, start + jnp.int32(n_pages), start),
+            overflow=ov.overflow | ~ok,
+        )
+        status = jnp.where(
+            ~ok & (machine.status == jnp.int32(int(StatusCode.RUNNING))),
+            jnp.int32(int(StatusCode.OVERLAY_FULL)), machine.status)
+        gpr = machine.gpr_l
+        gpr = gpr.at[:, len_gpr, 0].set(lens.astype(jnp.uint32))
+        gpr = gpr.at[:, len_gpr, 1].set(jnp.uint32(0))
+        gpr = gpr.at[:, ptr_gpr, 0].set(gva_l[0])
+        gpr = gpr.at[:, ptr_gpr, 1].set(gva_l[1])
+        return machine._replace(overlay=overlay, gpr_l=gpr,
+                                status=status)
+
+    return jax.jit(impl, donate_argnums=(0,) if donate else ())
+
+
 class Runner:
     """Owns the device batch + decode cache and drives the chunked run loop.
 
@@ -644,6 +711,37 @@ class Runner:
     # -- host memory access ------------------------------------------------
     def view(self) -> HostView:
         return HostView(self)
+
+    # -- mutate-on-device insert seam (wtf_tpu/devmut) ---------------------
+    def device_insert(self, words, lens, pfns, gva: int,
+                      len_gpr: int, ptr_gpr: int) -> None:
+        """Insert a device-generated batch without a host round-trip:
+        `words` (u32[L, W]) / `lens` (i32[L]) — typically straight from
+        devmut's generate dispatch — land in overlay slots [0, n_pages)
+        of every lane and the target's ABI registers are set in the same
+        program.  Call on a freshly restored machine (the overlay must
+        be empty; the fuzz loop's restore→insert ordering guarantees
+        it)."""
+        n_pages = len(pfns)
+        capacity = self.machine.overlay.pfn.shape[1]
+        if n_pages > capacity:
+            raise ValueError(
+                f"device-insert region spans {n_pages} pages but lanes "
+                f"have only {capacity} overlay slots — raise "
+                f"overlay_slots or shrink the mutator/spec max_len")
+        fn = _make_device_insert(n_pages, words.shape[1], len_gpr, ptr_gpr,
+                                 self._donate)
+        key = ("devins", n_pages, words.shape[1], len_gpr, ptr_gpr,
+               self.n_lanes, self._donate)
+        if key not in _DISPATCHED_EXECUTORS:
+            _DISPATCHED_EXECUTORS.add(key)
+            self.events.emit("compile", kind="device-insert",
+                             pages=n_pages, words=int(words.shape[1]))
+        gva_l = np.array([gva & 0xFFFF_FFFF, (gva >> 32) & 0xFFFF_FFFF],
+                         dtype=np.uint32)
+        self.machine = fn(self.machine, words, lens,
+                          jnp.asarray(np.asarray(pfns, dtype=np.int32)),
+                          jnp.asarray(gva_l))
 
     def push(self, view: HostView) -> None:
         """Apply a HostView's mutations (registers + buffered page writes +
