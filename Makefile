@@ -1,9 +1,12 @@
 # Developer / CI targets.  `make verify` is the PR gate: tier-1 tests
 # plus the graph-invariant linter (wtf_tpu/analysis) — both CPU-only.
+# `make mesh-smoke` is the fast end-to-end check of the mesh campaign
+# driver (wtf_tpu/meshrun) on a forced 8-device CPU mesh; run it when
+# touching the sharded executors or the --mesh-devices path.
 
 PY ?= python
 
-.PHONY: verify test lint lint-rebaseline slow
+.PHONY: verify test lint lint-rebaseline slow mesh-smoke
 
 verify: test lint
 
@@ -25,3 +28,13 @@ lint-rebaseline:
 slow:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m slow \
 		-p no:cacheprovider
+
+# fast forced-8-device mesh campaign smoke: the whole
+# `campaign --mesh-devices N --mutator devmangle` path (shard_map
+# executors, on-chip coverage merge, device mutation per shard) in one
+# process with no hardware
+mesh-smoke:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m wtf_tpu campaign --name demo_tlv --mesh-devices 8 \
+		--mutator devmangle --lanes 16 --runs 32 --limit 20000 --seed 7

@@ -152,6 +152,63 @@ def measure_devmut(n_lanes=None, limit=100_000, seconds=10.0):
     }), flush=True)
 
 
+def measure_lanes_ramp(seconds=None, limit=20_000):
+    """The chips x lanes ramp (ROADMAP item 1 / ISSUE 7): devmangle
+    campaigns through the meshrun driver at lanes x mesh-shard
+    combinations, reporting execs/s and cov/edge bits at equal wall per
+    cell — the scaling curve behind the SNIPPETS north-star chase
+    (>=1000x bochscpu exec/s on a v5e-8 at equal edge coverage).
+
+    On a real TPU the ramp runs lanes256..lanes4096 over 1 chip vs the
+    whole mesh; on the forced-8-device CPU stand-in (MULTICHIP_r06) it
+    scales down — there the claim is scaling MECHANICS (one process,
+    one SPMD program, coverage merged on-chip), not throughput: all
+    eight "chips" share the same cores, so execs/s parity with the
+    single-device cell is the expectation, not a speedup."""
+    import jax
+
+    from wtf_tpu.analysis.trace import build_tlv_campaign
+
+    on_tpu = jax.default_backend() == "tpu"
+    if seconds is None:
+        seconds = 10.0 if on_tpu else 4.0
+    n_dev = len(jax.devices())
+    lanes_list = (256, 1024, 4096) if on_tpu else (64, 256)
+    shards_list = [1] + ([n_dev] if n_dev > 1 else [])
+    cells = []
+    for n_lanes in lanes_list:
+        for shards in shards_list:
+            if n_lanes % shards:
+                continue
+            loop = build_tlv_campaign(
+                n_lanes=n_lanes, mutator="devmangle", limit=limit,
+                chunk_steps=128, overlay_slots=16,
+                mesh_devices=shards if shards > 1 else None)
+            loop.run_one_batch()   # warmup: compiles + decode servicing
+            c0 = loop.stats.testcases
+            t0 = time.time()
+            while time.time() - t0 < seconds:
+                loop.run_one_batch()
+            dt = time.time() - t0
+            agg_edge = np.asarray(loop.backend._agg_edge)
+            cell = {
+                "lanes": n_lanes, "shards": shards,
+                "execs_per_s": round((loop.stats.testcases - c0) / dt, 2),
+                "cov_bits": loop._coverage(),
+                "edge_bits": int(np.unpackbits(
+                    agg_edge.view("uint8")).sum()),
+                "testcases": loop.stats.testcases,
+            }
+            cells.append(cell)
+            print(json.dumps({"config": "lanes-ramp", **cell}), flush=True)
+    print(json.dumps({
+        "config": "lanes-ramp-summary", "limit": limit,
+        "seconds_per_cell": seconds, "devices": n_dev,
+        "platform": jax.devices()[0].platform, "cells": cells,
+    }), flush=True)
+    return cells
+
+
 def measure_deep(n_lanes=1024, limit=10_000_000, seconds=30.0):
     """BASELINE-config-3-shaped end-to-end number (the same workload
     bench.py reports in its `deep` extras): mangle campaign on demo_spin
@@ -197,7 +254,8 @@ if __name__ == "__main__":
 
     faulthandler.dump_traceback_later(
         int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
-    names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused", "devmut"]
+    names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused", "devmut",
+                                             "lanes"]
     for n in names:
         if n == "deep":
             measure_deep()
@@ -205,6 +263,8 @@ if __name__ == "__main__":
             measure_fused()
         elif n == "devmut":
             measure_devmut()
+        elif n == "lanes":
+            measure_lanes_ramp()
         else:
             measure(n, CONFIGS[n])
         faulthandler.cancel_dump_traceback_later()

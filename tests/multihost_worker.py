@@ -1,5 +1,6 @@
 """Worker process for the 2-process jax.distributed test (VERDICT r3
-item 6).  Launched by tests/test_parallel.py with env:
+item 6; PR 7 re-pointed it at the meshrun subsystem).  Launched by
+tests/test_parallel.py with env:
 
   WTF_COORD   coordinator address (localhost:port)
   WTF_NPROC   number of processes
@@ -7,10 +8,12 @@ item 6).  Launched by tests/test_parallel.py with env:
   (JAX_PLATFORMS=cpu and xla_force_host_platform_device_count are set by
   the parent so each process contributes 4 virtual CPU devices)
 
-Joins the distributed runtime via init_multihost, runs one sharded
-interpreter chunk over the global 8-device mesh, OR-reduces coverage
-across processes (DCN-analog collective), and prints one JSON line whose
-coverage digest the parent compares across both processes.
+Joins the distributed runtime via init_multihost, runs one shard_map
+mesh chunk (wtf_tpu/meshrun/executor.py) over the global 8-device mesh —
+the same executor MeshRunner dispatches — and reads back the merged
+coverage bitmap its in-graph boolean all-reduce produced (DCN-analog
+collective).  Prints one JSON line whose coverage digest the parent
+compares across both processes.
 """
 
 import json
@@ -23,9 +26,8 @@ def main() -> None:
 
     from wtf_tpu.harness import demo_tlv
     from wtf_tpu.interp.runner import Runner, warm_decode_cache
-    from wtf_tpu.interp.step import make_run_chunk
-    from wtf_tpu.parallel.mesh import (
-        init_multihost, merged_coverage, replicate, shard_machine,
+    from wtf_tpu.meshrun import (
+        init_multihost, make_mesh_chunk, replicate, shard_machine,
     )
 
     mesh = init_multihost(coordinator=os.environ["WTF_COORD"],
@@ -52,14 +54,11 @@ def main() -> None:
     machine = shard_machine(runner.machine, mesh)
     tab = replicate(runner.cache.device(), mesh)
     image = replicate(runner.physmem.image, mesh)
-    run_chunk = make_run_chunk(8)
-    with mesh:
-        machine = run_chunk(tab, image, machine, jnp.uint64(500))
-        cov, edge = merged_coverage(machine, groups=mesh.size)
+    # the mesh chunk's in-graph coverage all-reduce IS the cross-process
+    # collective under test: its output is replicated on every host
+    machine, cov, _edge = make_mesh_chunk(8, mesh, donate=False)(
+        tab, image, machine, jnp.uint64(500))
 
-    # merged_coverage's output is replicated; every process reads its own
-    # replica and the parent checks the digests agree (the cross-process
-    # OR-reduce is the thing under test)
     from jax.experimental import multihost_utils
 
     cov_local = np.asarray(cov.addressable_shards[0].data)

@@ -371,13 +371,14 @@ def test_rebaseline_without_budget_family_rejected():
 
 @pytest.mark.slow
 def test_full_lint_clean_on_tree(tmp_path):
-    """The acceptance gate: all four families against the real tree —
-    compiles the step ladder (~30s on the 1-core box), so slow tier;
-    tier-1 covers dtype via test_limbs and parity/negative paths above."""
+    """The acceptance gate: all five families against the real tree —
+    compiles the step ladder and the sharded mesh chunk (~minutes on
+    the 1-core box), so slow tier; tier-1 covers dtype via test_limbs,
+    parity/negative paths above, and mesh via test_meshrun."""
     from wtf_tpu.telemetry import Registry
 
     registry = Registry()
     findings, info = run_lint(registry=registry)
     assert findings == [], [str(f) for f in findings]
     assert info["kernel_counts"]["total"] == 168
-    assert registry.dump().get("analysis.families_run") == 4
+    assert registry.dump().get("analysis.families_run") == 5

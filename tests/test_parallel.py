@@ -169,7 +169,12 @@ def test_two_process_distributed_mesh(tmp_path):
     for rc, out, err in outs:
         if rc != 0 and ("DISTRIBUTED" in err.upper()
                         or "grpc" in err.lower()
-                        or "coordination" in err.lower()):
+                        or "coordination" in err.lower()
+                        # this jaxlib's CPU client cannot EXECUTE
+                        # multiprocess programs (it can compile them;
+                        # the single-process 8-device tests still cover
+                        # the sharded path) — a real pod runtime can
+                        or "multiprocess computations" in err.lower()):
             pytest.skip(f"distributed runtime unavailable: {err[-200:]}")
         assert rc == 0, err[-2000:]
     reports = [json.loads(next(ln for ln in out.splitlines()

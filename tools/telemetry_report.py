@@ -135,6 +135,24 @@ def summarize(path) -> dict:
               if "/" in name}
     breakdown = wall_breakdown(phase_seconds)
 
+    # mesh campaigns (wtf_tpu/meshrun): per-shard device counters next to
+    # the merged view — the operator's straggling/cold-chip check is
+    # "do the shards sum to the merged counter, and are they balanced"
+    mesh = None
+    if metrics.get("mesh.devices"):
+        shard_instr = metrics.get("device.shard_instructions", {})
+        if not isinstance(shard_instr, dict):
+            shard_instr = {}
+        per_shard = dict(sorted(shard_instr.items(),
+                                key=lambda kv: int(kv[0])))
+        mesh = {
+            "devices": metrics.get("mesh.devices"),
+            "lanes_per_shard": metrics.get("mesh.lanes_per_shard"),
+            "shard_instructions": per_shard,
+            "shard_instructions_sum": sum(per_shard.values()),
+            "merged_instructions": metrics.get("device.instructions", 0),
+        }
+
     testcases = metrics.get("campaign.testcases", 0) or 0
     fallbacks = metrics.get("runner.fallbacks_by_opclass", {})
     if not isinstance(fallbacks, dict):
@@ -190,6 +208,7 @@ def summarize(path) -> dict:
                                          or {})))
                 else None),
         },
+        "mesh": mesh,
         "errors": errors,
     }
 
@@ -248,6 +267,19 @@ def _print_human(s: dict) -> None:
     print(f"device counters: instructions={dev['instructions']} "
           f"mem_faults={dev['mem_faults']} "
           f"decode_misses={dev['decode_misses']}{fused}")
+    mesh = s.get("mesh")
+    if mesh:
+        print(f"mesh: {mesh['devices']} devices x "
+              f"{mesh['lanes_per_shard']} lanes/shard")
+        shards = mesh["shard_instructions"]
+        if shards:
+            per = " ".join(f"{k}={v}" for k, v in shards.items())
+            agree = ("" if mesh["shard_instructions_sum"]
+                     == mesh["merged_instructions"]
+                     else f" (merged view {mesh['merged_instructions']} "
+                          "DISAGREES)")
+            print(f"  per-shard instructions: {per} "
+                  f"(sum {mesh['shard_instructions_sum']}{agree})")
     for err in s["errors"]:
         print(f"error: {err['kind']}: {err['detail']}")
 

@@ -30,9 +30,10 @@ from typing import List, Optional
 from wtf_tpu.analysis.findings import Finding  # noqa: F401
 from wtf_tpu.analysis.parity import check_fused_parity  # noqa: F401
 from wtf_tpu.analysis.rules import (  # noqa: F401
-    FAMILIES, check_budget, check_no_u64, check_seam_bitcast_only,
-    check_signature_stable, check_strong_inputs, count_data_dependent_ops,
-    run_dtype_family, run_lint,
+    FAMILIES, check_budget, check_mesh_collectives, check_no_u64,
+    check_seam_bitcast_only, check_shard_stability, check_signature_stable,
+    check_strong_inputs, count_collective_ops, count_data_dependent_ops,
+    run_dtype_family, run_lint, run_mesh_family,
 )
 
 
@@ -95,6 +96,10 @@ def lint_main(families=None, budgets=None, rebaseline: bool = False,
         if counts:
             print("kernel counts: " + " ".join(
                 f"{k}={v}" for k, v in counts.items()), file=out)
+        collectives = info.get("collective_counts")
+        if collectives:
+            print("mesh collectives: " + " ".join(
+                f"{k}={v}" for k, v in collectives.items()), file=out)
         if "budgets_written" in info:
             print(f"re-baselined -> {info['budgets_written']}", file=out)
         state = ("CLEAN" if not findings

@@ -24,6 +24,15 @@ the result:
             PR-2 corruption class caught statically)
   parity    the fused-subset contract between pstep.py and step.py
             (wtf_tpu/analysis/parity.py)
+  mesh      the sharded chunk executor (wtf_tpu/meshrun) on a forced
+            multi-device CPU mesh: cross-device collectives pinned to
+            exactly the coverage all-reduce (no accidental resharding
+            of machine state — zero all-gather/all-to-all/permute), and
+            the compiled per-device program byte-stable across shard
+            counts at equal lanes-per-shard.  When the ambient process
+            has too few devices (plain `make lint`), the family re-runs
+            itself in a subprocess with
+            XLA_FLAGS=--xla_force_host_platform_device_count=8.
 
 `run_lint` orchestrates all families and reports Findings; helpers are
 public so tests can seed violations directly.
@@ -56,7 +65,23 @@ DATA_DEP_OPS = ("gather", "dynamic-slice", "dynamic-update-slice", "scatter")
 BUDGET_ENTRY = "xla_step"
 BUDGET_CONFIG = dict(n_lanes=4, chunk_steps=64, n_steps=64, donate=True)
 
-FAMILIES = ("dtype", "budget", "recompile", "parity")
+# the cross-device collective HLO ops the mesh family censuses: on the
+# lane mesh the compiled chunk may hold exactly ONE — the coverage
+# all-reduce; any gather-class op means machine state is being resharded
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
+                  "collective-permute", "collective-broadcast",
+                  "reduce-scatter")
+
+# canonical mesh-trace configuration: the census is pinned against the
+# 8-device arm; the 4-device arm (same lanes-per-shard) feeds the
+# shard-count stability rule.  donate=False matches the real CPU
+# dispatch policy (donation is unsound on XLA CPU — make_run_chunk).
+MESH_ENTRY = "mesh_chunk"
+MESH_DEVICES = 8
+MESH_CONFIG = dict(n_steps=16, lanes_per_shard=2,
+                   uop_capacity=1 << 10, overlay_slots=8, edge_bits=12)
+
+FAMILIES = ("dtype", "budget", "recompile", "parity", "mesh")
 
 _FORBID_64 = re.compile(r"\b(u64|s64|f64|f32)\[")
 # jaxpr primitives that move/reshape bits without computing on them (the
@@ -409,6 +434,194 @@ def check_donation_aliasing(compiled_text: str, machine,
 
 
 # ---------------------------------------------------------------------------
+# mesh family
+# ---------------------------------------------------------------------------
+
+def count_collective_ops(hlo_text: str) -> Dict[str, int]:
+    """Occurrences of each cross-device collective in partitioned HLO
+    text (plus "total") — the interconnect-traffic currency of the mesh
+    cost model (PERF.md round 11)."""
+    counts = {}
+    for name in COLLECTIVE_OPS:
+        pat = re.compile(r"(?<![\w\-])" + re.escape(name) + r"[\.\w]*\(")
+        counts[name] = len(pat.findall(hlo_text))
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def check_mesh_collectives(counts: Dict[str, int], budget: Dict[str, int],
+                           entry: str) -> List[Finding]:
+    """Exact pin against budgets.json's `mesh_chunk` entry: the sharded
+    chunk's only collective is the coverage all-reduce.  A gather-class
+    op appearing means machine state is crossing the interconnect —
+    an accidental reshard, the regression this family exists to catch."""
+    findings = []
+    for name in list(COLLECTIVE_OPS) + ["total"]:
+        got = counts.get(name, 0)
+        want = budget.get(name)
+        if want is None or got == want:
+            continue
+        direction = "over" if got > want else "under"
+        findings.append(Finding(
+            rule="mesh.collectives", entry=entry, primitive=name,
+            count=got, budget=want,
+            message=(f"cross-device `{name}` count {direction} the "
+                     "checked-in mesh budget — the compiled chunk's only "
+                     "collective is the coverage all-reduce; anything "
+                     "else reshards machine state over the interconnect. "
+                     "If intentional, re-baseline with `python -m "
+                     "wtf_tpu.analysis --rebaseline` and record why in "
+                     "PERF.md")))
+    return findings
+
+
+# partitioned-HLO details that legitimately vary with the mesh size
+# (device lists in sharding annotations / replica groups) — stripped
+# before the shard-count stability comparison
+_MESH_NORMALIZE = (
+    (re.compile(r"sharding=\{[^{}]*\}"), "sharding={...}"),
+    (re.compile(r"replica_groups=\{\{[^{}]*\}(,\{[^{}]*\})*\}"),
+     "replica_groups={...}"),
+    (re.compile(r"replica_groups=\{[^{}]*\}"), "replica_groups={...}"),
+    (re.compile(r"replica_groups=\[[^\]]*\]<=\[\d+\]"),
+     "replica_groups=[...]"),
+    (re.compile(r"num_partitions=\d+"), "num_partitions=N"),
+)
+
+
+def normalize_partitioned_hlo(text: str) -> str:
+    for pat, repl in _MESH_NORMALIZE:
+        text = pat.sub(repl, text)
+    return text
+
+
+def check_shard_stability(text_a: str, text_b: str,
+                          entry: str) -> List[Finding]:
+    """Two compiled mesh chunks at EQUAL lanes-per-shard but different
+    shard counts must be byte-identical per-device programs once the
+    device-list annotations are normalized; a diff means a shard-count-
+    dependent value leaked into the trace and every mesh resize pays a
+    silent recompile of a *different* program."""
+    na, nb = normalize_partitioned_hlo(text_a), normalize_partitioned_hlo(
+        text_b)
+    if na == nb:
+        return []
+    for i, (la, lb) in enumerate(zip(na.splitlines(), nb.splitlines())):
+        if la != lb:
+            detail = la.strip()[:80]
+            break
+    else:
+        detail, i = "length mismatch", -1
+    return [Finding(
+        rule="mesh.shard-unstable", entry=entry,
+        primitive=f"line {i + 1}: {detail}",
+        message=("the compiled per-device chunk differs across shard "
+                 "counts at equal lanes-per-shard — a mesh-size-dependent "
+                 "value is baked into the traced program"))]
+
+
+def _mesh_chunk_compiled(n_shards: int) -> str:
+    """Compiled partitioned HLO of the mesh chunk executor at
+    MESH_CONFIG's lanes-per-shard over `n_shards` devices."""
+    import jax
+    import jax.numpy as jnp
+
+    from wtf_tpu.meshrun.executor import make_mesh_chunk
+    from wtf_tpu.meshrun.mesh import make_mesh, replicate, shard_machine
+
+    cfg = MESH_CONFIG
+    runner = build_tlv_runner(
+        n_lanes=cfg["lanes_per_shard"] * n_shards,
+        chunk_steps=cfg["n_steps"], payload=None,
+        uop_capacity=cfg["uop_capacity"],
+        overlay_slots=cfg["overlay_slots"], edge_bits=cfg["edge_bits"])
+    mesh = make_mesh(n_shards)
+    machine = shard_machine(runner.machine, mesh)
+    tab = replicate(runner.cache.device(), mesh)
+    image = replicate(runner.physmem.image, mesh)
+    # jit=False: a fresh shard_map closure per lowering, same reasoning
+    # as step_executor_lowering's fresh-trace requirement
+    fn = jax.jit(make_mesh_chunk(cfg["n_steps"], mesh, donate=False,
+                                 jit=False))
+    return fn.lower(tab, image, machine,
+                    jnp.uint64(0)).compile().as_text()
+
+
+def _mesh_family_subprocess(budgets_path: Optional[Path],
+                            rebaseline: bool) -> Tuple[List[Finding], Dict]:
+    """Re-run ONLY the mesh family in a child interpreter with the
+    forced 8-device CPU platform (the ambient process has too few
+    devices and jax device topology is fixed at backend init)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # force EXACTLY MESH_DEVICES: an ambient flag pinning a smaller
+    # count must be overridden, not preserved, or the child is just as
+    # device-poor as the parent and the family reports unavailable
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count"
+                f"={MESH_DEVICES}").strip()
+    if env.get("WTF_LINT_MESH_SUBPROC"):
+        return [Finding(
+            rule="mesh.unavailable", entry=MESH_ENTRY,
+            message=(f"mesh family needs >= {MESH_DEVICES} devices but "
+                     "the forced-device subprocess still sees too few — "
+                     "platform cannot host a virtual mesh"))], {}
+    env["WTF_LINT_MESH_SUBPROC"] = "1"
+    cmd = [sys.executable, "-m", "wtf_tpu.analysis", "--families", "mesh",
+           "--json"]
+    if budgets_path is not None:
+        cmd += ["--budgets", str(budgets_path)]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=1800)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if line is None:
+        return [Finding(
+            rule="mesh.unavailable", entry=MESH_ENTRY,
+            message=("forced-8-device mesh subprocess produced no JSON "
+                     f"(rc={proc.returncode}): "
+                     f"{(proc.stderr or proc.stdout)[-200:]}"))], {}
+    out = json.loads(line)
+    findings = [Finding(**{k: f.get(k) for k in
+                           ("rule", "entry", "message", "primitive",
+                            "count", "budget")})
+                for f in out.get("findings", [])]
+    if rebaseline:
+        # parent is re-pinning: the measured counts matter, drift
+        # findings against the OLD budget don't
+        findings = [f for f in findings if f.rule != "mesh.collectives"]
+    return findings, {"collective_counts": out.get("collective_counts"),
+                      "entry": out.get("mesh_entry")}
+
+
+def run_mesh_family(budgets_path: Optional[Path] = None,
+                    rebaseline: bool = False) -> Tuple[List[Finding], Dict]:
+    """All mesh rules.  Returns (findings, info) where info carries the
+    measured collective census (for run_lint's rebaseline merge and the
+    `analysis.mesh_collectives` telemetry gauges)."""
+    import jax
+
+    if len(jax.devices()) < MESH_DEVICES:
+        return _mesh_family_subprocess(budgets_path, rebaseline)
+    entry = (f"make_mesh_chunk({MESH_CONFIG['n_steps']}, donate=False) / "
+             f"demo_tlv / {MESH_DEVICES} shards x "
+             f"{MESH_CONFIG['lanes_per_shard']} lanes")
+    text_full = _mesh_chunk_compiled(MESH_DEVICES)
+    text_half = _mesh_chunk_compiled(MESH_DEVICES // 2)
+    counts = count_collective_ops(text_full)
+    findings = check_shard_stability(text_full, text_half, entry=entry)
+    if not rebaseline:
+        budget = load_budgets(budgets_path).get(MESH_ENTRY, {})
+        findings.extend(check_mesh_collectives(counts, budget, entry=entry))
+    return findings, {"collective_counts": counts, "entry": entry}
+
+
+# ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
 
@@ -429,13 +642,16 @@ def run_lint(families: Optional[Sequence[str]] = None,
     if unknown:
         raise ValueError(f"unknown lint families: {sorted(unknown)} "
                          f"(known: {list(FAMILIES)})")
-    if rebaseline and "budget" not in families:
+    if rebaseline and not {"budget", "mesh"} & set(families):
         raise ValueError(
-            "--rebaseline rewrites the kernel-count budget, which only the "
-            "'budget' family measures — drop the families filter or "
-            "include budget in it")
+            "--rebaseline rewrites the kernel-count/collective budgets, "
+            "which only the 'budget' and 'mesh' families measure — drop "
+            "the families filter or include one of them")
     findings: List[Finding] = []
     info: Dict = {"families": families, "seconds": {}, "entries": []}
+    # entries re-measured this run; merged over the checked-in file on
+    # --rebaseline so a partial family filter never drops the others
+    measured_budgets: Dict[str, Dict] = {}
 
     needs_trace = {"budget", "recompile"} & set(families)
     runner = None
@@ -465,10 +681,8 @@ def run_lint(families: Optional[Sequence[str]] = None,
         counts = count_data_dependent_ops(compiled_text)
         info["kernel_counts"] = counts
         if rebaseline:
-            budgets = {BUDGET_ENTRY: {
-                "entry": info["entries"][0], **counts}}
-            info["budgets_written"] = str(save_budgets(budgets,
-                                                       budgets_path))
+            measured_budgets[BUDGET_ENTRY] = {
+                "entry": info["entries"][0], **counts}
         else:
             budget = load_budgets(budgets_path).get(BUDGET_ENTRY, {})
             findings.extend(check_budget(counts, budget,
@@ -520,6 +734,30 @@ def run_lint(families: Optional[Sequence[str]] = None,
         findings.extend(check_fused_parity())
         info["seconds"]["parity"] = round(time.time() - t0, 1)
         info["entries"].append("pstep.hot_class vs step.unsupported")
+
+    if "mesh" in families:
+        t0 = time.time()
+        mesh_findings, mesh_info = run_mesh_family(
+            budgets_path=budgets_path, rebaseline=rebaseline)
+        findings.extend(mesh_findings)
+        counts = mesh_info.get("collective_counts")
+        if counts:
+            info["collective_counts"] = counts
+            info["mesh_entry"] = mesh_info.get("entry")
+            for name, value in counts.items():
+                registry.gauge("analysis.mesh_collectives").labels(
+                    name).set(value)
+            if rebaseline:
+                measured_budgets[MESH_ENTRY] = {
+                    "entry": mesh_info.get("entry"), **counts}
+        if mesh_info.get("entry"):
+            info["entries"].append(mesh_info["entry"])
+        info["seconds"]["mesh"] = round(time.time() - t0, 1)
+
+    if rebaseline and measured_budgets:
+        budgets = load_budgets(budgets_path)
+        budgets.update(measured_budgets)
+        info["budgets_written"] = str(save_budgets(budgets, budgets_path))
 
     # telemetry: analysis.* namespace + one event per finding
     registry.gauge("analysis.families_run").set(len(families))

@@ -11,6 +11,10 @@ contract, so backends are interchangeable:
   tpu.py  - TpuBackend over the batched device interpreter: N testcase
             lanes per Run, the reason this framework exists
 
+A `mesh_devices` kwarg on the tpu backend upgrades it to the mesh
+campaign driver (wtf_tpu/meshrun): the same contract, lane count =
+lanes_per_chip x chips over a jax.sharding.Mesh.
+
 Selected by name like the reference's --backend flag (wtf.cc:208-225).
 """
 
@@ -23,7 +27,13 @@ def create_backend(name: str, snapshot, **kwargs) -> Backend:
     """Instantiate a backend by CLI name (reference wtf.cc:403-415)."""
     if name == "emu":
         kwargs.pop("n_lanes", None)
+        kwargs.pop("mesh_devices", None)
         return EmuBackend(snapshot, **kwargs)
     if name == "tpu":
+        if kwargs.get("mesh_devices") is not None:
+            from wtf_tpu.meshrun.backend import MeshBackend
+
+            return MeshBackend(snapshot, **kwargs)
+        kwargs.pop("mesh_devices", None)
         return TpuBackend(snapshot, **kwargs)
     raise ValueError(f"unknown backend {name!r} (expected emu|tpu)")

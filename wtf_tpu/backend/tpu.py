@@ -22,7 +22,6 @@ reference client revokes their coverage before reporting (client.cc:122-125).
 from __future__ import annotations
 
 from contextlib import contextmanager
-from functools import partial
 from typing import Dict, List, Optional, Sequence, Set
 
 import jax
@@ -35,6 +34,10 @@ from wtf_tpu.core.results import (
 )
 from wtf_tpu.core.results import StatusCode
 from wtf_tpu.interp.runner import HostView, Runner
+# the ONE coverage merge (reference master's set-union semantics,
+# server.h:816-854) — shared with the mesh backend, which swaps in the
+# shard-aware variant of the same core (meshrun/reduce.py)
+from wtf_tpu.meshrun.reduce import merge_coverage
 from wtf_tpu.snapshot.loader import Snapshot
 from wtf_tpu import telemetry
 from wtf_tpu.telemetry import Registry, StatsDict
@@ -47,35 +50,6 @@ _STATUS_TERMINAL_MAP = {
     StatusCode.TIMEDOUT: lambda self, lane: Timedout(),
     StatusCode.CR3_CHANGE: lambda self, lane: Cr3Change(),
 }
-
-
-@jax.jit
-def _merge_coverage(agg_cov, agg_edge, cov, edge, include):
-    """OR lane bitmaps (where `include`) into the aggregates.
-
-    Per-lane new-coverage credit follows the reference master's *sequential*
-    set-union merge (server.h:816-854): a lane counts as new only for bits
-    not in the aggregate AND not already contributed by a lower lane of the
-    same batch (cumulative-OR prefix).  Without this, every lane finding the
-    same new edge enters the corpus, polluting it with coverage-duplicate
-    testcases and measurably diluting guided search."""
-    inc = include[:, None]
-    cov_in = jnp.where(inc, cov, 0)
-    edge_in = jnp.where(inc, edge, 0)
-    cum_cov = jax.lax.associative_scan(jnp.bitwise_or, cov_in, axis=0)
-    cum_edge = jax.lax.associative_scan(jnp.bitwise_or, edge_in, axis=0)
-    prev_cov = jnp.concatenate(
-        [jnp.zeros_like(cov_in[:1]), cum_cov[:-1]], axis=0)
-    prev_edge = jnp.concatenate(
-        [jnp.zeros_like(edge_in[:1]), cum_edge[:-1]], axis=0)
-    new_lane = (
-        jnp.any((cov_in & ~agg_cov[None, :] & ~prev_cov) != 0, axis=1)
-        | jnp.any((edge_in & ~agg_edge[None, :] & ~prev_edge) != 0, axis=1))
-    cov_union = cum_cov[-1]
-    edge_union = cum_edge[-1]
-    new_cov_words = cov_union & ~agg_cov
-    return (agg_cov | cov_union, agg_edge | edge_union,
-            new_lane & include, new_cov_words)
 
 
 class TpuBackend(Backend):
@@ -99,6 +73,9 @@ class TpuBackend(Backend):
         self._lane_results: Dict[int, TestcaseResult] = {}
         self._agg_cov = None
         self._agg_edge = None
+        # the batch coverage merge — the mesh backend swaps in the
+        # shard-aware variant (same semantics, one all_gather)
+        self._merge = merge_coverage
         self._last_new_words: Optional[np.ndarray] = None
         self._trace_request = None
         # per-campaign counters (reference BochscpuRunStats_t role,
@@ -187,7 +164,7 @@ class TpuBackend(Backend):
                 & (statuses != int(StatusCode.OVERLAY_FULL))
                 & (np.arange(self.n_lanes) < n_active))
             (self._agg_cov, self._agg_edge, new_lane,
-             new_words) = _merge_coverage(
+             new_words) = self._merge(
                 self._agg_cov, self._agg_edge, m.cov, m.edge, include)
             self._new_lane = np.asarray(new_lane)
             self._last_new_words = np.asarray(new_words)
@@ -236,8 +213,11 @@ class TpuBackend(Backend):
         """This lane's executed-RIP set from its device bitmap (valid after
         run_batch, before restore).  Edge-hash coverage stays device-side;
         the wire protocol reports RIP coverage like the reference's
-        robin_set<Gva_t> (client.cc:187-200)."""
-        cov = np.asarray(self.runner.machine.cov)[lane]
+        robin_set<Gva_t> (client.cc:187-200).  Indexed on device first so
+        only the wanted lane's row transfers — on a mesh the [lanes,
+        words] plane spans shards and a full gather per harvested lane
+        would dominate the crash-fetch path."""
+        cov = np.asarray(jax.device_get(self.runner.machine.cov[lane]))
         return set(self.runner.cache.rips_of_bits(cov))
 
     def lane_result_detail(self, lane: int) -> str:
@@ -302,7 +282,7 @@ class TpuBackend(Backend):
             (statuses != int(StatusCode.TIMEDOUT))
             & (statuses != int(StatusCode.OVERLAY_FULL))
             & (np.arange(self.n_lanes) == 0))
-        self._agg_cov, self._agg_edge, new_lane, new_words = _merge_coverage(
+        self._agg_cov, self._agg_edge, new_lane, new_words = self._merge(
             self._agg_cov, self._agg_edge, m.cov, m.edge, include)
         self._new_lane = np.asarray(new_lane)
         self._last_new_words = np.asarray(new_words)

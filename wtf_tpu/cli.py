@@ -63,8 +63,18 @@ def _add_target_selection(p: argparse.ArgumentParser) -> None:
                         "registration")
 
 
-def _add_backend_tuning(p: argparse.ArgumentParser) -> None:
+def _add_backend_tuning(p: argparse.ArgumentParser, mesh: bool = False
+                        ) -> None:
     """Execution-engine knobs of the tpu backend (ignored by emu)."""
+    if mesh:
+        p.add_argument("--mesh-devices", type=int, default=None,
+                       metavar="N",
+                       help="shard the lane batch over a device mesh "
+                            "(wtf_tpu/meshrun): N devices, 0 = every "
+                            "local device.  --lanes is the TOTAL lane "
+                            "count (lanes/N per chip) and must divide "
+                            "by N; coverage OR-reduces on-chip, so the "
+                            "fuzz loop sees one logical backend")
     p.add_argument("--fused-step", choices=("off", "auto", "on"),
                    default="off",
                    help="fused Pallas fast path (interp/pstep.py): one "
@@ -85,6 +95,9 @@ def _backend_tuning_kwargs(args) -> dict:
     tier = getattr(args, "burst_any_tier", "auto")
     if tier != "auto":
         kwargs["burst_any_tier"] = tier == "on"
+    mesh = getattr(args, "mesh_devices", None)
+    if mesh is not None:
+        kwargs["mesh_devices"] = mesh
     return kwargs
 
 
@@ -125,7 +138,7 @@ def build_parser() -> argparse.ArgumentParser:
                       help="one multiplexed master connection for the whole"
                            " lane batch instead of one per lane (scales a"
                            " wide node past the master's fd budget)")
-    _add_backend_tuning(fuzz)
+    _add_backend_tuning(fuzz, mesh=True)
 
     master = sub.add_parser("master", help="master node (serves testcases)")
     _add_target_selection(master)
@@ -174,7 +187,7 @@ def build_parser() -> argparse.ArgumentParser:
                            " multi-host launch (host:port)")
     camp.add_argument("--num-processes", type=int, default=None)
     camp.add_argument("--process-id", type=int, default=None)
-    _add_backend_tuning(camp)
+    _add_backend_tuning(camp, mesh=True)
 
     lint = sub.add_parser(
         "lint", help="graph-invariant static analysis of the hot-path "
@@ -324,6 +337,7 @@ def cmd_fuzz(args) -> int:
     opts = FuzzOptions(name=args.name, backend=args.backend,
                        limit=args.limit, address=args.address,
                        seed=args.seed, lanes=args.lanes,
+                       mesh_devices=args.mesh_devices,
                        paths=_paths_from(args))
     target = _lookup_target(args)
     with _telemetry_for(args) as (registry, events):
@@ -377,6 +391,7 @@ def cmd_campaign(args) -> int:
                            limit=args.limit, runs=args.runs,
                            max_len=args.max_len, seed=args.seed,
                            lanes=args.lanes, mutator=args.mutator,
+                           mesh_devices=args.mesh_devices,
                            stop_on_crash=args.stop_on_crash,
                            paths=_paths_from(args))
     if args.coordinator or args.num_processes:
@@ -384,9 +399,9 @@ def cmd_campaign(args) -> int:
         # coordination; tests/test_parallel.py exercises the same path on
         # 2 CPU processes).  Each host then drives its local chips; the
         # global mesh is available to sharded execution paths
-        # (parallel/mesh.py), and cross-host work distribution rides the
+        # (wtf_tpu/meshrun), and cross-host work distribution rides the
         # TCP master plane exactly like separate pods.
-        from wtf_tpu.parallel.mesh import init_multihost
+        from wtf_tpu.meshrun.mesh import init_multihost
 
         init_multihost(coordinator=args.coordinator,
                        num_processes=args.num_processes,

@@ -63,6 +63,10 @@ class FuzzOptions:
     address: str = DEFAULT_ADDRESS
     seed: int = 0
     lanes: int = 64
+    # None = single device; 0 = every local device; N = first N devices.
+    # The node becomes ONE logical backend of `lanes` total lanes sharded
+    # lanes/N per chip (wtf_tpu/meshrun).
+    mesh_devices: Optional[int] = None
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
 
 
@@ -91,5 +95,9 @@ class CampaignOptions:
     seed: int = 0
     lanes: int = 64
     mutator: str = "auto"   # auto | byte | mangle | tlv | devmangle
+    # None = single device; 0 = every local device; N = first N devices
+    # (wtf_tpu/meshrun: lanes shard over the mesh, coverage reduces
+    # on-chip, the loop sees one logical backend)
+    mesh_devices: Optional[int] = None
     stop_on_crash: bool = False
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)

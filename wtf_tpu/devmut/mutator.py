@@ -108,22 +108,23 @@ class DevMangleMutator(Mutator):
 
     # -- batch generation --------------------------------------------------
     def _dispatch(self) -> Tuple:
-        from wtf_tpu.devmut.engine import make_generate
-        import jax.numpy as jnp
-
         data, lens, cumw, synced = self.corpus.arrays()
         if synced:
             self.stats["corpus_syncs"] += 1
         self.stats["corpus_slots"] = len(self.corpus)
-        seeds = jnp.asarray(
-            hostref.lane_seeds(self.seed, self._batch, self.n_lanes))
-        key = (self.rounds, data.shape, seeds.shape)
+        seeds = hostref.lane_seeds(self.seed, self._batch, self.n_lanes)
+        key = (self.rounds, data.shape, seeds.shape, self.runner.exec_sig)
         if key not in _DISPATCHED_GEN:
             _DISPATCHED_GEN.add(key)
             self.events.emit("compile", kind="devmut-gen",
                              rounds=self.rounds, slots=data.shape[0],
                              words=data.shape[1], lanes=self.n_lanes)
-        out = make_generate(self.rounds)(data, lens, cumw, seeds)
+        # through the runner's generation seam: a mesh runner runs the
+        # generator per shard (slab replicated, seed stream lane-sharded)
+        # with the identical per-lane program, so the byte stream stays
+        # bit-exact against hostref.lane_seeds on any mesh size
+        out = self.runner.devmut_generate(self.rounds, data, lens, cumw,
+                                          seeds)
         self._batch += 1
         self.stats["batches"] += 1
         self.stats["generated"] += self.n_lanes

@@ -594,6 +594,13 @@ class Runner:
         self.events = events if events is not None else NULL
         self.snapshot = snapshot
         self.physmem = snapshot.physmem
+        # the image operand executors dispatch against (a mesh runner
+        # re-points this at a replicated placement; host-side page reads
+        # keep going through self.physmem)
+        self.image = snapshot.physmem.image
+        # extra executor-identity tag mixed into compile-event keys
+        # (mesh runners dispatch different programs at the same shapes)
+        self.exec_sig: Tuple = ()
         self.cpu0 = snapshot.cpu
         self.n_lanes = n_lanes
         self.cache = DecodeCache(capacity=uop_capacity)
@@ -615,7 +622,6 @@ class Runner:
         # this graph (see make_run_chunk's caveat) and donation buys
         # nothing on a host backend anyway.
         self._donate = jax.default_backend() != "cpu"
-        self._run_chunk = make_run_chunk(chunk_steps, donate=self._donate)
         # Fused Pallas fast path (interp/pstep.py): per chunk the runner
         # dispatches the fused kernel first, then a SHORT XLA chunk that
         # resumes lanes the kernel parked (NEEDS_XLA) — the park-and-
@@ -691,12 +697,41 @@ class Runner:
             labeled=("fallbacks_by_opclass",))
         self.stats["max_chunk_steps"] = chunk_steps
 
+    # -- device dispatch surface (the seams MeshRunner re-points) ----------
+    def device_tab(self):
+        """The dispatch-ready uop table (mesh runners hand back a
+        replicated placement of the same pytree)."""
+        return self.cache.device()
+
+    def _chunk_callable(self, n_steps: int):
+        """The executor run() dispatches for one chunk of `n_steps`
+        (memoized in step._CHUNK_CACHE; mesh runners swap in the
+        shard_map executor, meshrun/executor.py)."""
+        return make_run_chunk(n_steps, donate=self._donate)
+
+    def _fused_callables(self):
+        """(fused kernel, resume leg) pair for _fused_dispatch."""
+        from wtf_tpu.interp.pstep import make_run_fused, make_run_resume
+
+        return (make_run_fused(self.fused_k),
+                make_run_resume(self.fused_resume_steps,
+                                donate=self._donate))
+
+    def devmut_generate(self, rounds: int, data, lens, cumw, seeds):
+        """Dispatch one devmut batch generation (wtf_tpu/devmut) — the
+        seam the device mutator drives, so mesh runners can run the
+        generator per shard with the slab replicated and the seed stream
+        lane-sharded."""
+        from wtf_tpu.devmut.engine import make_generate
+
+        return make_generate(rounds)(data, lens, cumw, jnp.asarray(seeds))
+
     # -- trace-capture hooks (ablate.py / bench.py / wtf_tpu.analysis) -----
     def executor_operands(self) -> Tuple:
         """(tab, image, machine, limit) — the chunk executor's positional
         operands, exactly as run() dispatches them.  The export hook for
         benches and the static analyzer; no private-state reach-in."""
-        return (self.cache.device(), self.physmem.image, self.machine,
+        return (self.device_tab(), self.image, self.machine,
                 jnp.uint64(self.limit))
 
     def chunk_executor(self, n_steps: Optional[int] = None,
@@ -732,7 +767,7 @@ class Runner:
         fn = _make_device_insert(n_pages, words.shape[1], len_gpr, ptr_gpr,
                                  self._donate)
         key = ("devins", n_pages, words.shape[1], len_gpr, ptr_gpr,
-               self.n_lanes, self._donate)
+               self.n_lanes, self._donate, self.exec_sig)
         if key not in _DISPATCHED_EXECUTORS:
             _DISPATCHED_EXECUTORS.add(key)
             self.events.emit("compile", kind="device-insert",
@@ -1125,18 +1160,15 @@ class Runner:
         is exactly one park event, so fused occupancy equals the hot
         fraction of the instruction stream.  Rounds stop early once no
         lane is RUNNING (everything needs host servicing or finished)."""
-        from wtf_tpu.interp.pstep import make_run_fused, make_run_resume
-
-        run_fused = make_run_fused(self.fused_k)
-        run_resume = make_run_resume(self.fused_resume_steps,
-                                     donate=self._donate)
-        fkey = ("fused", self.fused_k, self.n_lanes, shape_sig)
+        run_fused, run_resume = self._fused_callables()
+        fkey = ("fused", self.fused_k, self.n_lanes, shape_sig,
+                self.exec_sig)
         if fkey not in _DISPATCHED_EXECUTORS:
             _DISPATCHED_EXECUTORS.add(fkey)
             self.events.emit("compile", kind="pallas-fused",
                              k_steps=self.fused_k)
         rkey = ("resume", self.fused_resume_steps, self._donate,
-                self.n_lanes, shape_sig)
+                self.n_lanes, shape_sig, self.exec_sig)
         if rkey not in _DISPATCHED_EXECUTORS:
             _DISPATCHED_EXECUTORS.add(rkey)
             self.events.emit("compile",
@@ -1144,12 +1176,12 @@ class Runner:
                              donate=self._donate, kind="fused-resume")
         for _ in range(max(self.fused_rounds, 1)):
             with spans.span("pallas-step") as sp:
-                self.machine = run_fused(tab, self.physmem.image,
+                self.machine = run_fused(tab, self.image,
                                          self.machine, limit)
                 sp.fence(self.machine.status)
             with spans.span("device-step") as sp:
                 # resumes parked lanes; ends with NO lane in NEEDS_XLA
-                self.machine = run_resume(tab, self.physmem.image,
+                self.machine = run_resume(tab, self.image,
                                           self.machine, limit)
                 sp.fence(self.machine.status)
             # copy, not a view (donation note in run())
@@ -1169,13 +1201,13 @@ class Runner:
         backend layer supplies it; reference breakpoint dispatch is
         backend.h:231 + kvm_backend.cc:1256-1369).  Returns the final status
         array."""
-        tab = self.cache.device()
+        tab = self.device_tab()
         # jit also keys on operand shapes: a second Runner with the same
         # (size, donate, lanes) but a different physmem image or uop-table
         # capacity still pays a real XLA compile and must report it
         shape_sig = tuple(
             a.shape for a in jax.tree_util.tree_leaves(
-                (tab, self.physmem.image)))
+                (tab, self.image)))
         limit = jnp.uint64(self.limit)
         self._chunk_level = 0
         self._fallback_streak = {}
@@ -1189,9 +1221,9 @@ class Runner:
                         if self.adaptive_chunks else self.chunk_steps)
                 self.stats["max_chunk_steps"] = max(
                     self.stats["max_chunk_steps"], size)
-                run_chunk = (make_run_chunk(size, donate=self._donate)
-                             if self.adaptive_chunks else self._run_chunk)
-                compile_key = (size, self._donate, self.n_lanes, shape_sig)
+                run_chunk = self._chunk_callable(size)
+                compile_key = (size, self._donate, self.n_lanes, shape_sig,
+                               self.exec_sig)
                 if compile_key not in _DISPATCHED_EXECUTORS:
                     # the first dispatch of this executor shape pays the
                     # XLA compile (jit compiles on call, not on
@@ -1205,7 +1237,7 @@ class Runner:
                                      donate=self._donate)
                 with spans.span("device-step") as sp:
                     self.machine = run_chunk(
-                        tab, self.physmem.image, self.machine, limit)
+                        tab, self.image, self.machine, limit)
                     # explicit fence: JAX dispatch is async; without it
                     # this span times Python dispatch and the device time
                     # leaks into whichever later span synchronizes first
@@ -1295,7 +1327,7 @@ class Runner:
                     view.set_status(lane, StatusCode.RUNNING)
             with spans.span("service-push"):
                 self.push(view)
-                tab = self.cache.device()
+                tab = self.device_tab()
         raise RuntimeError("run loop exceeded max_chunks")
 
     def restore(self) -> None:

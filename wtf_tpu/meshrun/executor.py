@@ -1,0 +1,132 @@
+"""shard_map-wrapped device executors: the mesh dispatch units.
+
+Where the single-device runner dispatches `make_run_chunk` (a global
+while_loop over the whole batch), the mesh runner dispatches these: the
+SAME chunk body runs per shard under `shard_map`, so
+
+  * the while-loop's "any lane still RUNNING" condition is shard-LOCAL —
+    shards early-exit independently instead of paying a cross-device
+    all-reduce per loop iteration;
+  * machine state never crosses the interconnect (every per-lane op is
+    shard-local by construction — the lint's `mesh` family pins the
+    compiled program to zero gather-class collectives);
+  * the chunk program ends with the shard-local u32 OR + [words, 32]
+    boolean all-reduce of the cov/edge bitmaps, so the host reads back
+    ONE merged bitmap per chunk instead of gathering [lanes, words]
+    planes — the only cross-chip traffic of the hot loop.
+
+The fused Pallas kernel (interp/pstep.py) wraps the same way: the kernel
+grid runs over the shard's local lanes, and its XLA resume leg doubles
+as the merged-coverage producer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from wtf_tpu.interp.step import make_run_chunk
+from wtf_tpu.meshrun.mesh import LANE_AXIS
+from wtf_tpu.meshrun.reduce import bitplane_or
+
+_MESH_CHUNK_CACHE: dict = {}
+_MESH_FUSED_CACHE: dict = {}
+
+
+def _chunk_with_coverage(body):
+    """Wrap a machine->machine chunk body so the program also emits the
+    cross-shard merged cov/edge bitmaps (shard-local OR, then one
+    boolean all-reduce over the concatenated planes)."""
+
+    def local(tab, image, machine, limit):
+        m = body(tab, image, machine, limit)
+        loc = jnp.bitwise_or.reduce(
+            jnp.concatenate([m.cov, m.edge], axis=1), axis=0)
+        merged = bitplane_or(loc, LANE_AXIS)
+        wc = m.cov.shape[1]
+        return m, merged[:wc], merged[wc:]
+
+    return local
+
+
+def make_mesh_chunk(n_steps: int, mesh, donate: Optional[bool] = None,
+                    jit: bool = True):
+    """Build (or fetch) the mesh chunk executor:
+    (tab, image, machine, limit) -> (machine', merged_cov, merged_edge)
+    with tab/image replicated, machine lane-sharded, merged replicated.
+
+    Same memoization/donation policy as step.make_run_chunk (donation is
+    unsound on the XLA CPU backend — see that docstring).  jit=False
+    returns the undecorated shard_map callable, a fresh closure per call
+    — the static analyzer's trace probe, exactly like make_run_chunk's."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    key = (n_steps, mesh, donate)
+    if jit:
+        cached = _MESH_CHUNK_CACHE.get(key)
+        if cached is not None:
+            return cached
+    body = make_run_chunk(n_steps, donate=donate, jit=False)
+    fn = shard_map(
+        _chunk_with_coverage(body), mesh=mesh,
+        in_specs=(P(), P(), P(LANE_AXIS), P()),
+        out_specs=(P(LANE_AXIS), P(), P()),
+        check_rep=False)
+    if not jit:
+        return fn
+    fn = jax.jit(fn, donate_argnums=(2,) if donate else ())
+    _MESH_CHUNK_CACHE[key] = fn
+    return fn
+
+
+def make_mesh_fused(k_steps: int, mesh):
+    """The fused Pallas kernel (interp/pstep.py) per shard: the pallas
+    grid spans the shard's LOCAL lanes (the kernel reads its lane count
+    from the block it is handed), machine stays lane-sharded, and no
+    collective is emitted — parked lanes are resumed by the mesh resume
+    leg, which also carries the merged-coverage all-reduce."""
+    key = (k_steps, mesh)
+    cached = _MESH_FUSED_CACHE.get(key)
+    if cached is not None:
+        return cached
+    from wtf_tpu.interp.pstep import make_run_fused
+
+    run_fused = make_run_fused(k_steps)
+    fn = jax.jit(shard_map(
+        lambda tab, image, machine, limit: run_fused(
+            tab, image, machine, limit),
+        mesh=mesh,
+        in_specs=(P(), P(), P(LANE_AXIS), P()),
+        out_specs=P(LANE_AXIS),
+        check_rep=False))
+    _MESH_FUSED_CACHE[key] = fn
+    return fn
+
+
+def make_mesh_resume(n_steps: int, mesh, donate: Optional[bool] = None):
+    """The fused ladder's XLA resume leg per shard (see pstep.
+    make_run_resume for the park/hold/release contract), extended like
+    make_mesh_chunk to emit the merged cov/edge bitmaps — the fused
+    mesh round's one collective rides here."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    key = ("resume", n_steps, mesh, donate)
+    cached = _MESH_CHUNK_CACHE.get(key)
+    if cached is not None:
+        return cached
+    from wtf_tpu.interp.pstep import make_run_resume
+
+    # the memoized single-device executor is jitted; tracing through it
+    # inside shard_map inlines the program, donation stays on the outer
+    run_resume = make_run_resume(n_steps, donate=False)
+    fn = jax.jit(shard_map(
+        _chunk_with_coverage(run_resume), mesh=mesh,
+        in_specs=(P(), P(), P(LANE_AXIS), P()),
+        out_specs=(P(LANE_AXIS), P(), P()),
+        check_rep=False), donate_argnums=(2,) if donate else ())
+    _MESH_CHUNK_CACHE[key] = fn
+    return fn

@@ -1,10 +1,9 @@
 """Multi-chip scaling: shard the lane axis over a device mesh.
 
-The reference scales by running N independent client *processes* against one
-master over TCP (SURVEY.md §2.7); the TPU-native equivalent keeps ONE batch
-whose lane axis is sharded across chips with `jax.sharding` — XLA inserts
-the ICI collectives (the coverage OR-reduce becomes an all-reduce) and the
-host runner stays oblivious.
+Promoted to the first-class `wtf_tpu.meshrun` subsystem in PR 7 (mesh
+campaign driver: shard_map executors, MeshRunner/MeshBackend, the
+shard-aware coverage reduce).  This package remains as a back-compat
+import surface only.
 """
 
 from wtf_tpu.parallel.mesh import (  # noqa: F401

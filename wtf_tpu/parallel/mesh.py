@@ -1,134 +1,20 @@
-"""Lane-axis sharding over a jax.sharding.Mesh.
+"""Back-compat shim: lane-axis sharding moved to wtf_tpu/meshrun/.
 
-Design (SURVEY.md §2.7.3): the fuzzer's only parallel axis is *testcases*
-(lanes) — the analog of data parallelism.  Machine state is SoA arrays with
-a leading lane axis, so sharding is one PartitionSpec over that axis; the
-snapshot image and uop table are replicated (every chip interprets against
-the same read-only memory image); coverage aggregation is an OR-reduce over
-the lane axis, which XLA turns into an ICI all-reduce when lanes span chips.
-
-Multi-host: the same mesh spans processes (jax distributed runtime); the
-corpus/crash plane stays host-side and distributes over the reference's TCP
-protocol (dist/), which needs no device awareness.
+PR 7 promoted this module into the `meshrun` subsystem (mesh
+construction in meshrun/mesh.py, the coverage OR-reduce family in
+meshrun/reduce.py, plus the shard_map executors / MeshRunner /
+MeshBackend that did not exist here).  The old import surface keeps
+working for existing tests and tools; new code should import from
+wtf_tpu.meshrun directly.
 """
 
-from __future__ import annotations
+from wtf_tpu.meshrun.mesh import (  # noqa: F401
+    LANE_AXIS, init_multihost, lane_sharding, make_mesh, replicate,
+    replicated_sharding, shard_machine,
+)
+from wtf_tpu.meshrun.reduce import (  # noqa: F401
+    merge_coverage, merged_coverage, or_reduce_lanes,
+)
 
-from functools import partial
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from wtf_tpu.interp.machine import Machine
-
-LANE_AXIS = "lanes"
-
-
-def make_mesh(n_devices: Optional[int] = None) -> Mesh:
-    devices = jax.devices()
-    if n_devices is not None:
-        devices = devices[:n_devices]
-    return Mesh(np.array(devices), (LANE_AXIS,))
-
-
-def init_multihost(coordinator: Optional[str] = None,
-                   num_processes: Optional[int] = None,
-                   process_id: Optional[int] = None) -> Mesh:
-    """Multi-host campaign entry point: join the jax distributed runtime
-    (DCN coordination; args default from the cluster environment) and
-    return the global lane mesh over every chip of every host.
-
-    This replaces the reference's process-per-core fan-out INSIDE the
-    pod: one mesh, lanes sharded across all chips, coverage OR-reduce
-    riding ICI within hosts and DCN across (XLA picks the collectives).
-    Across independent pods, the TCP master/node plane (wtf_tpu.dist)
-    still applies unchanged — a whole pod is one BatchClient."""
-    kwargs = {}
-    if coordinator is not None:
-        kwargs["coordinator_address"] = coordinator
-    if num_processes is not None:
-        kwargs["num_processes"] = num_processes
-    if process_id is not None:
-        kwargs["process_id"] = process_id
-    if not jax.distributed.is_initialized():
-        jax.distributed.initialize(**kwargs)  # raises on a bad coordinator
-    return make_mesh()
-
-
-def _is_multiprocess(mesh: Mesh) -> bool:
-    me = jax.process_index()
-    return any(d.process_index != me for d in mesh.devices.flat)
-
-
-def _place(leaf, sharding, mesh: Mesh):
-    """device_put within one process; across processes every host holds
-    the same global value (machines broadcast from one snapshot, images
-    and uop tables are replicated by construction), so each process
-    donates its addressable shards of that value via the callback form."""
-    if not _is_multiprocess(mesh):
-        return jax.device_put(leaf, sharding)
-    arr = np.asarray(leaf)
-    return jax.make_array_from_callback(
-        arr.shape, sharding, lambda idx: arr[idx])
-
-
-def shard_machine(machine: Machine, mesh: Mesh) -> Machine:
-    """Place every per-lane leaf with its leading axis split over the mesh.
-
-    n_lanes must divide by mesh size.  Returns the same pytree with
-    device-sharded arrays; everything downstream (run_chunk, coverage
-    merge) is shape-identical, so jit compiles SPMD executables with XLA
-    inserting the cross-chip collectives.  On a multi-host mesh every
-    process must call this with the SAME host value (true for machines
-    built from one snapshot) and the array becomes global."""
-    sharding = NamedSharding(mesh, P(LANE_AXIS))
-    return jax.tree.map(lambda leaf: _place(leaf, sharding, mesh), machine)
-
-
-def replicate(tree, mesh: Mesh):
-    """Replicate snapshot image / uop table on every mesh device."""
-    sharding = NamedSharding(mesh, P())
-    return jax.tree.map(lambda leaf: _place(leaf, sharding, mesh), tree)
-
-
-def _or_reduce_lanes(words, groups: Optional[int]):
-    """OR-reduce u32 bitmaps over the (possibly sharded) lane axis.
-
-    XLA's cross-device reduction set covers sum/min/max but not u32
-    bitwise-or, so a plain `bitwise_or.reduce` over a sharded axis fails
-    to partition.  Split the reduction instead: the expensive [L, W] part
-    is a shard-local bitwise OR (no collective, no expansion), and only
-    the small [g, W, 32] per-bit view crosses devices via `jnp.any`'s
-    boolean all-reduce.  (The former formulation expanded the full
-    [L, W, 32] bit tensor — 32x the bitmap bytes — before reducing.)
-
-    The group count must be a multiple of the lane-mesh size or the
-    "local" OR itself crosses shards; callers that hold the mesh pass
-    `groups` (merged_coverage's static arg).  The default — the largest
-    power-of-two divisor of n_lanes, capped at 256 — stays shard-local
-    for any power-of-two mesh up to 256 devices."""
-    n = words.shape[0]
-    g = groups if groups else min(n & -n, 256)
-    grouped = words.reshape(g, n // g, -1)
-    local = jnp.bitwise_or.reduce(grouped, axis=1)        # [g, W]
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    bits = jnp.any((local[..., None] >> shifts) & jnp.uint32(1) != 0,
-                   axis=0)                                # [W, 32]
-    return jnp.sum(bits.astype(jnp.uint32) << shifts, axis=-1)
-
-
-@partial(jax.jit, static_argnames=("groups",))
-def merged_coverage(machine: Machine, groups: Optional[int] = None):
-    """Batch-wide coverage union: OR-reduce the per-lane cov/edge bitmaps
-    over the lane axis.  Under a sharded lane axis this lowers to an
-    all-reduce over ICI — the device-side replacement for the reference
-    master's set-union merge (server.h:816-854).
-
-    Pass `groups` = a multiple of the lane-mesh device count (e.g.
-    `mesh.size`) on meshes wider than 256 or with non-power-of-two
-    device counts; see `_or_reduce_lanes`."""
-    return (_or_reduce_lanes(machine.cov, groups),
-            _or_reduce_lanes(machine.edge, groups))
+# pre-promotion private name, kept for any out-of-tree caller
+_or_reduce_lanes = or_reduce_lanes
