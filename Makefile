@@ -6,9 +6,9 @@
 
 PY ?= python
 
-.PHONY: verify test lint lint-rebaseline slow mesh-smoke
+.PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke
 
-verify: test lint
+verify: test lint chaos-smoke
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -38,3 +38,10 @@ mesh-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m wtf_tpu campaign --name demo_tlv --mesh-devices 8 \
 		--mutator devmangle --lanes 16 --runs 32 --limit 20000 --seed 7
+
+# deterministic fault-tolerance soak (wtf_tpu/testing/chaos_smoke):
+# seeded fault schedule over the real socket + checkpoint seams —
+# >=1 reconnect, >=1 reclaim, >=1 torn-checkpoint .prev fallback, zero
+# lost testcases, bit-identical kill/resume parity.  Exit 0 = all held.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.chaos_smoke

@@ -1,0 +1,483 @@
+"""Dist-tier fault-tolerance under the deterministic chaos harness
+(wtf_tpu/testing/faultinject): reconnect with backoff, in-flight reclaim
+on drop and on silence, SIGTERM drain, transient dial retry, torn corpus
+tolerance — all over the real wire protocol."""
+
+import errno
+import random
+import socket
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from wtf_tpu.backend import create_backend
+from wtf_tpu.core.results import Ok
+from wtf_tpu.dist import BatchClient, Client, MasterLink, Server, wire
+from wtf_tpu.fuzz.corpus import Corpus
+from wtf_tpu.fuzz.mutator import TlvStructureMutator
+from wtf_tpu.harness import demo_tlv
+from wtf_tpu.telemetry import Registry
+from wtf_tpu.testing.faultinject import (
+    FaultPlan, PARTIAL_SEND, RESET, chaos_dialing,
+)
+
+from test_harness import BENIGN, OVERFLOW, tlv
+
+
+def _addr(tmp_path: Path) -> str:
+    return f"unix://{tmp_path}/master.sock"
+
+
+def _serve(server, seconds=120.0):
+    t = threading.Thread(target=server.run, kwargs={"max_seconds": seconds})
+    t.start()
+    return t
+
+
+def _emu_backend():
+    backend = create_backend("emu", demo_tlv.build_snapshot())
+    backend.initialize()
+    return backend
+
+
+class _Events:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, type, **fields):  # noqa: A002
+        self.records.append({"type": type, **fields})
+
+    def heartbeat(self, *a, **k):
+        pass
+
+    def of(self, type):  # noqa: A002
+        return [r for r in self.records if r["type"] == type]
+
+
+# ---------------------------------------------------------------------------
+# wire: transient dial retry (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_dial_retries_transient_oserrors(tmp_path, monkeypatch):
+    """EHOSTUNREACH/ETIMEDOUT/EINTR inside the retry window retry like
+    ECONNREFUSED instead of aborting instantly."""
+    listener = wire.listen(_addr(tmp_path))
+    calls = {"n": 0}
+    real = socket.socket
+
+    class Flaky(socket.socket):
+        def connect(self, addr):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise OSError(
+                    [errno.EHOSTUNREACH, errno.ETIMEDOUT][calls["n"] - 1],
+                    "chaos")
+            return real.connect(self, addr)
+
+    monkeypatch.setattr(wire.socket, "socket", Flaky)
+    try:
+        sock = wire.dial(_addr(tmp_path), retry_for=10.0)
+        sock.close()
+    finally:
+        listener.close()
+    assert calls["n"] == 3  # two transient failures retried, then in
+
+
+def test_dial_aborts_on_nontransient_error(tmp_path, monkeypatch):
+    calls = {"n": 0}
+
+    class Denied(socket.socket):
+        def connect(self, addr):
+            calls["n"] += 1
+            raise PermissionError(errno.EACCES, "chaos")
+
+    monkeypatch.setattr(wire.socket, "socket", Denied)
+    with pytest.raises(PermissionError):
+        wire.dial(_addr(tmp_path), retry_for=10.0)
+    assert calls["n"] == 1  # configuration errors never burn the window
+
+
+def test_dial_transient_reraises_past_deadline(tmp_path, monkeypatch):
+    class Unreachable(socket.socket):
+        def connect(self, addr):
+            raise OSError(errno.EHOSTUNREACH, "chaos")
+
+    monkeypatch.setattr(wire.socket, "socket", Unreachable)
+    start = time.monotonic()
+    with pytest.raises(OSError):
+        wire.dial(_addr(tmp_path), retry_for=0.3)
+    assert time.monotonic() - start >= 0.25
+
+
+def test_tagged_wire_frames():
+    a, b = socket.socketpair()
+    try:
+        wire.send_work(a, b"payload", tagged=True)
+        wire.send_bye(a)
+        assert wire.recv_tagged(b) == (wire.TAG_WORK, b"payload")
+        assert wire.recv_tagged(b) == (wire.TAG_BYE, b"")
+        wire.send_msg(a, b"")
+        with pytest.raises(ValueError, match="empty frame"):
+            wire.recv_tagged(b)
+        a.close()
+        assert wire.recv_tagged(b) is None
+    finally:
+        b.close()
+
+
+def test_masterlink_bye_stops_retry(tmp_path):
+    """BYE is terminal: a link with a 30s retry budget must NOT burn it
+    after an orderly goodbye."""
+    listener = wire.listen(_addr(tmp_path))
+
+    def serve():
+        conn, _ = listener.accept()
+        wire.recv_msg(conn)  # hello
+        wire.send_bye(conn)
+        conn.close()
+
+    t = threading.Thread(target=serve)
+    t.start()
+    link = MasterLink(_addr(tmp_path), 1, max_retry_secs=30.0,
+                      rng=random.Random(0))
+    link.connect()
+    start = time.monotonic()
+    assert link.recv_work() is None
+    assert time.monotonic() - start < 5.0  # no retry loop after BYE
+    assert link._bye
+    link.close()
+    t.join(timeout=10)
+    listener.close()
+
+
+def test_faultplan_seeded_is_deterministic():
+    a = FaultPlan.seeded(42, n_sockets=4, faults_per_socket=2)
+    b = FaultPlan.seeded(42, n_sockets=4, faults_per_socket=2)
+    assert a.socket_schedules == b.socket_schedules
+    assert FaultPlan.seeded(43, 4).socket_schedules != a.socket_schedules
+
+
+# ---------------------------------------------------------------------------
+# client reconnect + master reclaim (the chaos soak, tier-1 size)
+# ---------------------------------------------------------------------------
+
+def test_client_reconnect_chaos_zero_lost(tmp_path):
+    """Scheduled resets + torn frames mid-campaign: the node reconnects
+    (dist.retries), the master reclaims its in-flight work
+    (dist.reclaimed), and the campaign still accounts EXACTLY
+    seeds + runs results with an exactly-deduped corpus."""
+    runs = 16
+    rng = random.Random(7)
+    outputs = tmp_path / "outputs"
+    corpus = Corpus(outputs_dir=outputs, rng=rng)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 128), corpus,
+                    crashes_dir=tmp_path / "crashes", runs=runs)
+    seeds = [BENIGN, tlv((2, b"ABCDEFGH"))]
+    server.paths = list(seeds)
+    thread = _serve(server)
+    registry = Registry()
+    # node op pattern: send(hello)=0, then recv,recv,send per testcase —
+    # reset a result send (reclaim) and tear a later one (torn frame)
+    plan = FaultPlan([{9: RESET}, {6: PARTIAL_SEND}, {}, {}],
+                     delay_secs=0.002)
+    with chaos_dialing(plan):
+        client = Client(_emu_backend(), demo_tlv.TARGET, _addr(tmp_path),
+                        registry=registry, max_retry_secs=30.0,
+                        retry_rng=random.Random(1))
+        served = client.run()
+    thread.join(timeout=120)
+    assert not thread.is_alive()
+    assert plan.count_fired(RESET) == 1
+    assert plan.count_fired(PARTIAL_SEND) == 1
+    # zero lost: every seed and every mutation accounted exactly once
+    assert server.stats.testcases == len(seeds) + runs
+    assert server.mutations == runs
+    assert served >= len(seeds) + runs  # re-executions land on the node
+    assert registry.counter("dist.retries").value >= 2
+    assert server.registry.counter("dist.reclaimed").value == 2
+    # exact server-side dedup: outputs/ is content-addressed and intact
+    for p in outputs.iterdir():
+        from wtf_tpu.utils.hashing import hex_digest
+
+        assert hex_digest(p.read_bytes()) == p.name
+
+
+def test_mux_batch_client_reconnects(tmp_path):
+    """The 1-fd batch node shape survives a mid-campaign reset too: the
+    whole in-flight batch reclaims and re-serves."""
+    runs = 8
+    rng = random.Random(3)
+    corpus = Corpus(rng=rng)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    crashes_dir=tmp_path / "crashes", runs=runs)
+    server.paths = [BENIGN, OVERFLOW, tlv((2, b"ABCDEFGH")),
+                    tlv((1, b"\x05"))]
+    thread = _serve(server, seconds=180)
+    backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                             n_lanes=4, limit=50_000)
+    backend.initialize()
+    registry = Registry()
+    # mux node ops: send(hello)=0, recv(batch)x2, send(replies)... —
+    # reset the second round's reply send: 4 in-flight testcases reclaim
+    plan = FaultPlan([{6: RESET}, {}, {}], delay_secs=0.002)
+    with chaos_dialing(plan):
+        node = BatchClient(backend, demo_tlv.TARGET, _addr(tmp_path),
+                           mux=True, registry=registry,
+                           max_retry_secs=60.0,
+                           retry_rng=random.Random(2))
+        node.run()
+    thread.join(timeout=180)
+    assert not thread.is_alive()
+    assert plan.count_fired(RESET) == 1
+    assert server.stats.testcases == 4 + runs  # zero lost
+    assert registry.counter("dist.retries").value >= 1
+    assert server.registry.counter("dist.reclaimed").value >= 1
+    assert server.stats.crashes >= 1  # OVERFLOW still landed
+
+
+def test_client_without_retry_budget_keeps_reference_behavior(tmp_path):
+    """max_retry_secs=0 (the library default): first socket loss ends
+    the node, exactly the pre-fault-tolerance semantics."""
+    rng = random.Random(11)
+    corpus = Corpus(rng=rng)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    runs=50)
+    server.paths = [BENIGN]
+    thread = _serve(server)
+    registry = Registry()
+    plan = FaultPlan([{3: RESET}])  # first result send dies
+    with chaos_dialing(plan):
+        client = Client(_emu_backend(), demo_tlv.TARGET, _addr(tmp_path),
+                        registry=registry)
+        client.run(max_runs=5)
+    assert registry.counter("dist.retries").value == 0
+    server.runs = server.mutations  # release the master's budget wait
+    thread.join(timeout=120)
+    assert not thread.is_alive()
+
+
+def test_wire_v1_client_speaks_legacy_hello(tmp_path):
+    """`--wire-v1`: raw downstream frames against a master that predates
+    WTF2 (here: the current master, which serves v1 to a v1 hello), no
+    reconnect semantics — the rolling-upgrade escape hatch."""
+    rng = random.Random(17)
+    corpus = Corpus(rng=rng)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    runs=4)
+    server.paths = [BENIGN]
+    thread = _serve(server)
+    client = Client(_emu_backend(), demo_tlv.TARGET, _addr(tmp_path),
+                    max_retry_secs=30.0, wire_v1=True)
+    served = client.run()
+    thread.join(timeout=120)
+    assert not thread.is_alive()
+    assert served == 1 + 4  # full campaign over raw frames
+    assert server.stats.testcases == 5
+
+
+def test_batchclient_master_gone_costs_one_retry_window(tmp_path):
+    """A dead master (close without BYE — what kill -9 produces) must
+    cost the non-mux fleet ONE retry window, not n_lanes serial windows:
+    the first exhausted lane zeroes its siblings' budgets."""
+    addr = _addr(tmp_path)
+    listener = wire.listen(addr)
+
+    def accept_serve_die():
+        conns = []
+        for _ in range(4):
+            c, _ = listener.accept()
+            wire.recv_msg(c)  # hello
+            conns.append(c)
+        for c in conns:
+            wire.send_work(c, BENIGN, tagged=True)
+        time.sleep(0.3)
+        for c in conns:
+            c.close()
+        listener.close()
+
+    t = threading.Thread(target=accept_serve_die)
+    t.start()
+    backend = create_backend("tpu", demo_tlv.build_snapshot(),
+                             n_lanes=4, limit=50_000)
+    backend.initialize()
+    node = BatchClient(backend, demo_tlv.TARGET, addr,
+                       max_retry_secs=1.0, retry_rng=random.Random(3))
+    start = time.monotonic()
+    served = node.run()
+    retry_elapsed = time.monotonic() - start
+    t.join(timeout=30)
+    assert served == 4  # round 1 executed; replies were abandoned
+    # one ~1s window for the fleet (plus execute time), NOT 4 x 1s
+    assert retry_elapsed < 3.5, retry_elapsed
+
+
+# ---------------------------------------------------------------------------
+# master: heartbeat-timeout reclaim + SIGTERM drain
+# ---------------------------------------------------------------------------
+
+def test_master_reclaims_silent_node(tmp_path):
+    """A node that takes work and goes silent past reclaim_timeout is
+    presumed dead: its in-flight testcase re-serves to a live node and
+    the campaign completes with zero lost.  Seeds come from inputs/
+    FILES — lazy Path entries keep the master waiting for a client even
+    while no node is connected (the pre-existing minset contract), which
+    makes the zombie -> reclaim -> healthy-node sequence deterministic."""
+    runs = 6
+    inputs = tmp_path / "inputs"
+    inputs.mkdir()
+    (inputs / "a").write_bytes(BENIGN)
+    (inputs / "b").write_bytes(tlv((2, b"ABCDEFGH")))
+    rng = random.Random(5)
+    events = _Events()
+    corpus = Corpus(rng=rng)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    inputs_dir=inputs, runs=runs, reclaim_timeout=0.3,
+                    events=events)
+    thread = _serve(server)
+    # the zombie: greets, takes one testcase, never replies
+    zombie = wire.dial(_addr(tmp_path), retry_for=10.0)
+    wire.send_msg(zombie, wire.encode_hello(1))
+    assert wire.recv_msg(zombie) is not None
+    # wait until the master presumed it dead and reclaimed its work
+    deadline = time.monotonic() + 30
+    while (server.registry.counter("dist.reclaimed").value < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert server.registry.counter("dist.reclaimed").value == 1
+    # a healthy node now drains the whole campaign incl. the reclaim
+    client = Client(_emu_backend(), demo_tlv.TARGET, _addr(tmp_path))
+    served = client.run()
+    thread.join(timeout=120)
+    zombie.close()
+    assert not thread.is_alive()
+    assert server.stats.testcases == 2 + runs  # zero lost
+    assert served == 2 + runs
+    reclaims = events.of("reclaim")
+    assert reclaims and reclaims[0]["reason"] == "timeout"
+
+
+def test_sigterm_drain(tmp_path):
+    """request_drain (the SIGTERM handler's body): in-flight results get
+    a grace window, nodes are told BYE, coverage persists, run() exits
+    with `drained` — the exit-0 path."""
+    rng = random.Random(9)
+    events = _Events()
+    corpus = Corpus(rng=rng)
+    cov_path = tmp_path / "coverage.cov"
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    runs=10_000, coverage_path=cov_path, events=events,
+                    drain_grace=2.0)
+    server.paths = [BENIGN]
+    thread = _serve(server)
+    # a tagged node holding one in-flight testcase
+    sock = wire.dial(_addr(tmp_path), retry_for=10.0)
+    wire.send_msg(sock, wire.encode_hello(1, tagged=True))
+    testcase = wire.recv_tagged(sock)
+    assert testcase is not None and testcase[0] == wire.TAG_WORK
+    server.request_drain()
+    # deliver the in-flight result inside the grace window
+    wire.send_msg(sock, wire.encode_result(testcase[1], {0x1000}, Ok()))
+    thread.join(timeout=60)
+    assert not thread.is_alive()
+    assert server.drained
+    # the node was told not to come back
+    got = wire.recv_tagged(sock)
+    assert got is not None and got[0] == wire.TAG_BYE
+    sock.close()
+    assert events.of("drain")
+    # persisted atomically on the way out
+    import json as _json
+
+    assert _json.loads(cov_path.read_text())["addresses"] == [0x1000]
+
+
+def test_cmd_master_drain_exits_zero(tmp_path, monkeypatch, capsys):
+    """The CLI driver returns 0 on a drained master (the supervisor
+    contract: SIGTERM -> persist -> exit 0), even with crashes on the
+    books — a drain is a clean stop, not a finding."""
+    import wtf_tpu.cli as cli
+
+    def fake_run(self, max_seconds=None):
+        self.stats.crashes = 3
+        self.drained = True
+        return self.stats
+
+    monkeypatch.setattr(Server, "run", fake_run)
+    rc = cli.main(["master", "--name", "demo_tlv",
+                   "--target", str(tmp_path),
+                   "--address", _addr(tmp_path),
+                   "--runs", "5", "--reclaim-timeout", "30"])
+    assert rc == 0
+    assert "master drained" in capsys.readouterr().out
+
+
+def test_sigterm_handler_installed_in_main_thread(tmp_path):
+    """Server.run arms SIGTERM -> request_drain when (and only when) it
+    owns the main thread, and restores the previous handler on exit."""
+    import signal
+
+    rng = random.Random(1)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64),
+                    Corpus(rng=rng), runs=1)
+    seen = {}
+
+    def probe():
+        seen["handler"] = signal.getsignal(signal.SIGTERM)
+        server.request_drain()  # also ends the run() promptly
+
+    before = signal.getsignal(signal.SIGTERM)
+    orig_drain = Server._drain_step
+
+    def drain_and_probe(self, now):
+        probe()
+        return orig_drain(self, now)
+
+    server._drain_step = drain_and_probe.__get__(server)
+    server.request_drain()
+    server.run(max_seconds=10)  # main thread: handler installs
+    assert callable(seen["handler"])
+    assert seen["handler"] is not before  # the drain hook was armed
+    assert signal.getsignal(signal.SIGTERM) is before  # and restored
+    assert server.drained
+
+
+# ---------------------------------------------------------------------------
+# torn corpus replay tolerance (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_torn_corpus_file_skipped_on_replay(tmp_path):
+    """A truncated/torn outputs/ entry (content no longer matches its
+    digest name) is skipped with a warning + JSONL error event; the rest
+    of the resume replays normally."""
+    outputs = tmp_path / "outputs"
+    outputs.mkdir()
+    from wtf_tpu.utils.hashing import hex_digest
+
+    good = BENIGN
+    (outputs / hex_digest(good)).write_bytes(good)
+    torn = tlv((2, b"ABCDEFGH"))
+    # digest-named file whose content was torn by a kill mid-write
+    (outputs / hex_digest(torn)).write_bytes(torn[: len(torn) // 2])
+    # an operator-named inputs file is exempt from the digest contract
+    inputs = tmp_path / "inputs"
+    inputs.mkdir()
+    (inputs / "operator-seed").write_bytes(tlv((3, b"ok")))
+
+    rng = random.Random(13)
+    events = _Events()
+    corpus = Corpus(outputs_dir=outputs, rng=rng)
+    server = Server(_addr(tmp_path), TlvStructureMutator(rng, 64), corpus,
+                    inputs_dir=inputs, runs=0, events=events)
+    thread = _serve(server)
+    client = Client(_emu_backend(), demo_tlv.TARGET, _addr(tmp_path))
+    served = client.run()
+    thread.join(timeout=120)
+    assert not thread.is_alive()
+    # good output + operator seed replayed; the torn entry skipped
+    assert served == 2
+    assert server.stats.testcases == 2
+    errs = [r for r in events.of("error")
+            if r.get("kind") == "torn-corpus-file"]
+    assert len(errs) == 1

@@ -100,12 +100,17 @@ def summarize(path) -> dict:
     crashes: dict = {}
     errors = []
     compiles_by_shape: dict = {}
+    checkpoint_bytes: list = []
+    checkpoint_secs: list = []
     for rec in records:
         by_type[rec["type"]] = by_type.get(rec["type"], 0) + 1
         if rec["type"] == "crash" and rec.get("name"):
             crashes[rec["name"]] = crashes.get(rec["name"], 0) + 1
         elif rec["type"] == "error":
             errors.append({k: rec.get(k) for k in ("kind", "detail")})
+        elif rec["type"] == "checkpoint":
+            checkpoint_bytes.append(rec.get("bytes", 0))
+            checkpoint_secs.append(rec.get("seconds", 0.0))
         elif rec["type"] == "compile":
             # one executor "shape" = the compile event's own payload
             # (chunk_steps/donate/kind/...) minus the stream bookkeeping
@@ -152,6 +157,29 @@ def summarize(path) -> dict:
             "shard_instructions_sum": sum(per_shard.values()),
             "merged_instructions": metrics.get("device.instructions", 0),
         }
+
+    # resilience (fault-tolerance tier): reconnect/reclaim/resume
+    # activity + checkpoint cadence and cost.  None when the run had no
+    # fault-tolerance signal at all — quiet campaigns stay quiet.
+    resilience = None
+    res_signals = {
+        "retries": metrics.get("dist.retries", 0) or 0,
+        "reconnects": by_type.get("reconnect", 0),
+        "reclaimed_testcases": metrics.get("dist.reclaimed", 0) or 0,
+        "resumes": metrics.get("campaign.resumes", 0) or 0,
+        "checkpoints": metrics.get("campaign.checkpoints", 0) or 0,
+        "drains": by_type.get("drain", 0),
+    }
+    if any(res_signals.values()) or checkpoint_bytes:
+        phase_secs = metrics.get("phase.seconds", {}) or {}
+        resilience = dict(res_signals)
+        resilience["checkpoint_seconds_total"] = round(
+            phase_secs.get("checkpoint", 0.0)
+            if isinstance(phase_secs, dict) else 0.0, 4)
+        if checkpoint_bytes:
+            resilience["checkpoint_last_bytes"] = checkpoint_bytes[-1]
+            resilience["checkpoint_mean_seconds"] = round(
+                sum(checkpoint_secs) / len(checkpoint_secs), 4)
 
     testcases = metrics.get("campaign.testcases", 0) or 0
     fallbacks = metrics.get("runner.fallbacks_by_opclass", {})
@@ -209,6 +237,7 @@ def summarize(path) -> dict:
                 else None),
         },
         "mesh": mesh,
+        "resilience": resilience,
         "errors": errors,
     }
 
@@ -280,6 +309,17 @@ def _print_human(s: dict) -> None:
                           "DISAGREES)")
             print(f"  per-shard instructions: {per} "
                   f"(sum {mesh['shard_instructions_sum']}{agree})")
+    res = s.get("resilience")
+    if res:
+        ckpt = (f", checkpoints={res['checkpoints']} "
+                f"({res['checkpoint_seconds_total']}s total"
+                + (f", last {res['checkpoint_last_bytes']}B, "
+                   f"mean {res['checkpoint_mean_seconds']}s"
+                   if "checkpoint_last_bytes" in res else "") + ")")
+        print(f"resilience: retries={res['retries']} "
+              f"reconnects={res['reconnects']} "
+              f"reclaimed={res['reclaimed_testcases']} "
+              f"resumes={res['resumes']} drains={res['drains']}{ckpt}")
     for err in s["errors"]:
         print(f"error: {err['kind']}: {err['detail']}")
 

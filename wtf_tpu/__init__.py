@@ -19,6 +19,8 @@ Layering (mirrors SURVEY.md section 1's layer map, redesigned TPU-first):
   fuzz/     - corpus, mutators (python + native), dirwatch, loop     (L5)
   dist/     - master/node wire protocol + reactor                    (L5)
   parallel/ - device mesh sharding, multi-chip coverage reduction    (L5)
+  resume/   - crash-safe campaign checkpoint/resume                  (L5)
+  testing/  - deterministic chaos harness (fault injection)          (aux)
   trace/    - rip/cov/tenet trace writers                            (aux)
   native/   - on-demand-built C++ components (kdmp, mangle)          (aux)
   cli.py    - `master|fuzz|run|campaign` subcommands                 (L6)

@@ -206,6 +206,22 @@ class TpuBackend(Backend):
         return [self._map_result(lane, statuses[lane])
                 for lane in range(self.n_lanes)]
 
+    # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
+    def coverage_state(self):
+        """(cov words, edge words) aggregate bitmaps as host arrays — the
+        coverage half of a campaign checkpoint.  Bit indices are decode-
+        cache entry indices; the checkpoint carries the cache alongside
+        (Runner.checkpoint_state) so they stay meaningful."""
+        return (np.asarray(jax.device_get(self._agg_cov)),
+                np.asarray(jax.device_get(self._agg_edge)))
+
+    def restore_coverage_state(self, cov: np.ndarray,
+                               edge: np.ndarray) -> None:
+        """Install checkpointed aggregate bitmaps.  The mesh backend
+        overrides placement (aggregates live replicated on every chip)."""
+        self._agg_cov = jnp.asarray(cov)
+        self._agg_edge = jnp.asarray(edge)
+
     def lane_found_new_coverage(self, lane: int) -> bool:
         return bool(self._new_lane[lane])
 

@@ -138,6 +138,16 @@ def build_parser() -> argparse.ArgumentParser:
                       help="one multiplexed master connection for the whole"
                            " lane batch instead of one per lane (scales a"
                            " wide node past the master's fd budget)")
+    fuzz.add_argument("--max-retry-secs", type=float, default=60.0,
+                      help="survive mid-campaign socket loss: reconnect "
+                           "with jittered exponential backoff for this "
+                           "long before giving up (0 = reference "
+                           "behavior: first loss ends the node)")
+    fuzz.add_argument("--wire-v1", action="store_true",
+                      help="speak the legacy (pre-WTF2) hello to a "
+                           "not-yet-upgraded master: raw downstream "
+                           "frames, no BYE, and therefore no reconnect "
+                           "(rolling-upgrade escape hatch)")
     _add_backend_tuning(fuzz, mesh=True)
 
     master = sub.add_parser("master", help="master node (serves testcases)")
@@ -148,6 +158,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="mutation budget; 0 = minset over inputs/")
     master.add_argument("--max_len", type=int, default=1024 * 1024)
     master.add_argument("--seed", type=int, default=0)
+    master.add_argument("--reclaim-timeout", type=float, default=0.0,
+                        help="reclaim in-flight testcases from a node "
+                             "silent this long (presumed dead); 0 = off. "
+                             "Reclaim-on-disconnect is always on; SIGTERM "
+                             "drains gracefully either way")
 
     snap = sub.add_parser(
         "snapshot", help="convert snapshots between formats")
@@ -182,6 +197,21 @@ def build_parser() -> argparse.ArgumentParser:
                            "(tpu backend + a target with a "
                            "DeviceInsertSpec only)")
     camp.add_argument("--stop-on-crash", action="store_true")
+    camp.add_argument("--checkpoint-every", type=int, default=0,
+                      metavar="N",
+                      help="crash-safe checkpointing (wtf_tpu/resume): "
+                           "persist the resumable campaign state every N "
+                           "batches (atomic tmp+fsync+rename; previous "
+                           "generation kept as .prev).  A kill at any "
+                           "point costs at most one interval")
+    camp.add_argument("--checkpoint-dir", type=Path, default=None,
+                      help="checkpoint directory (default: "
+                           "<target>/checkpoint when --target is given; "
+                           "a --resume dir is reused)")
+    camp.add_argument("--resume", type=Path, default=None, metavar="DIR",
+                      help="resume from a checkpoint dir: coverage, crash "
+                           "set, corpus, RNG and devmut streams restore "
+                           "bit-identically to the uninterrupted run")
     camp.add_argument("--coordinator", default=None,
                       help="jax.distributed coordinator address for a"
                            " multi-host launch (host:port)")
@@ -338,6 +368,7 @@ def cmd_fuzz(args) -> int:
                        limit=args.limit, address=args.address,
                        seed=args.seed, lanes=args.lanes,
                        mesh_devices=args.mesh_devices,
+                       max_retry_secs=args.max_retry_secs,
                        paths=_paths_from(args))
     target = _lookup_target(args)
     with _telemetry_for(args) as (registry, events):
@@ -348,11 +379,15 @@ def cmd_fuzz(args) -> int:
         if opts.backend == "tpu":
             node = BatchClient(backend, target, opts.address, mux=args.mux,
                                registry=registry, events=events,
-                               print_stats=True)
+                               print_stats=True,
+                               max_retry_secs=opts.max_retry_secs,
+                               wire_v1=args.wire_v1)
         else:
             node = Client(backend, target, opts.address,
                           registry=registry, events=events,
-                          print_stats=True)
+                          print_stats=True,
+                          max_retry_secs=opts.max_retry_secs,
+                          wire_v1=args.wire_v1)
         served = node.run()
     print(f"node served {served} testcases")
     return 0
@@ -364,7 +399,9 @@ def cmd_master(args) -> int:
 
     opts = MasterOptions(name=args.name, address=args.address,
                          runs=args.runs, max_len=args.max_len,
-                         seed=args.seed, paths=_paths_from(args))
+                         seed=args.seed,
+                         reclaim_timeout=args.reclaim_timeout,
+                         paths=_paths_from(args))
     target = _lookup_target(args)
     with _telemetry_for(args) as (registry, events):
         rng = random.Random(opts.seed or None)
@@ -377,9 +414,15 @@ def cmd_master(args) -> int:
                         crashes_dir=opts.paths.crashes, runs=opts.runs,
                         max_len=opts.max_len, print_stats=True,
                         coverage_path=coverage_path,
-                        registry=registry, events=events)
+                        registry=registry, events=events,
+                        reclaim_timeout=opts.reclaim_timeout)
         stats = server.run()
     print(server.stats.line(len(server.coverage), len(corpus), 0))
+    if server.drained:
+        # SIGTERM drain: state persisted, nodes notified — a supervisor
+        # restarting the master must read this as a clean stop
+        print("master drained (state persisted)")
+        return 0
     return 0 if stats.crashes == 0 else 2
 
 
@@ -393,7 +436,21 @@ def cmd_campaign(args) -> int:
                            lanes=args.lanes, mutator=args.mutator,
                            mesh_devices=args.mesh_devices,
                            stop_on_crash=args.stop_on_crash,
+                           checkpoint_every=args.checkpoint_every,
+                           checkpoint_dir=args.checkpoint_dir,
+                           resume=args.resume,
                            paths=_paths_from(args))
+    # checkpoint dir defaulting: explicit flag > the resume dir (a
+    # resumed campaign keeps checkpointing in place) > <target>/checkpoint
+    ckpt_dir = opts.checkpoint_dir or opts.resume
+    if ckpt_dir is None and opts.checkpoint_every and opts.paths.target:
+        ckpt_dir = Path(opts.paths.target) / "checkpoint"
+    if opts.checkpoint_every and ckpt_dir is None:
+        raise SystemExit("--checkpoint-every needs --checkpoint-dir "
+                         "(or --target to default one under)")
+    if opts.resume and opts.runs == 0:
+        raise SystemExit("--resume applies to fuzz campaigns "
+                         "(--runs > 0); minset replays are stateless")
     if args.coordinator or args.num_processes:
         # multi-host launch: join the jax distributed runtime first (DCN
         # coordination; tests/test_parallel.py exercises the same path on
@@ -431,7 +488,18 @@ def cmd_campaign(args) -> int:
                    else create_mutator(opts.mutator, rng, opts.max_len))
         loop = FuzzLoop(backend, target, mutator,
                         corpus, crashes_dir=opts.paths.crashes,
-                        registry=registry, events=events)
+                        registry=registry, events=events,
+                        checkpoint_dir=ckpt_dir,
+                        checkpoint_every=opts.checkpoint_every)
+        if opts.resume:
+            from wtf_tpu.resume import load_campaign, restore_campaign
+
+            state, fell_back = load_campaign(opts.resume)
+            batch = restore_campaign(loop, state, opts.resume)
+            note = " (newest torn; resumed from .prev)" if fell_back else ""
+            print(f"resumed at batch {batch}: "
+                  f"{loop.stats.testcases} testcases, "
+                  f"{len(corpus)} corpus entries{note}")
         if opts.runs == 0:
             # reference semantics (server.h:552-556): replay the seeds —
             # plus any prior campaign's outputs/, so a corpus can minimize

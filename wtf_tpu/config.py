@@ -67,6 +67,10 @@ class FuzzOptions:
     # The node becomes ONE logical backend of `lanes` total lanes sharded
     # lanes/N per chip (wtf_tpu/meshrun).
     mesh_devices: Optional[int] = None
+    # mid-campaign socket-loss budget: reconnect with jittered backoff
+    # for this long before the node gives up (0 = reference behavior:
+    # first loss ends the node)
+    max_retry_secs: float = 60.0
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
 
 
@@ -79,6 +83,10 @@ class MasterOptions:
     runs: int = 0
     max_len: int = 1024 * 1024
     seed: int = 0
+    # reclaim in-flight testcases from a node that has been silent this
+    # long (presumed dead: wedged chip, half-open TCP); 0 = off —
+    # drop-detection reclaim is always on regardless
+    reclaim_timeout: float = 0.0
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
 
 
@@ -100,4 +108,11 @@ class CampaignOptions:
     # on-chip, the loop sees one logical backend)
     mesh_devices: Optional[int] = None
     stop_on_crash: bool = False
+    # crash-safe checkpoint/resume (wtf_tpu/resume): checkpoint the
+    # resumable campaign state every N batches (0 = off) into
+    # checkpoint_dir (defaults under the target root); resume replays a
+    # checkpoint dir bit-identically to the uninterrupted run
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[Path] = None
+    resume: Optional[Path] = None
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)

@@ -39,6 +39,15 @@ class DeviceCorpus:
         self.count = 0
         self._dirty = True
         self._dev: Optional[Tuple] = None
+        # Undo log since the last device upload (checkpoint/resume): the
+        # prelaunched batch N+1 was generated from the slab AS UPLOADED,
+        # which by checkpoint time has diverged from the host-authoritative
+        # slab (batch N's harvest added finds).  Recording each slot's
+        # pre-image at its first post-upload mutation lets uploaded_state()
+        # reconstruct exactly what the pending batch sampled — without
+        # keeping a full second copy of a possibly-huge slab.
+        self._undo: Dict[int, Tuple] = {}
+        self._uploaded_count = 0
 
     def __len__(self) -> int:
         return self.count
@@ -55,6 +64,7 @@ class DeviceCorpus:
         slot = self._slot_of.get(digest)
         if slot is not None:
             if weight > self._weight[slot]:
+                self._note_undo(slot)
                 self._weight[slot] = weight
                 self._dirty = True
             return False
@@ -64,6 +74,7 @@ class DeviceCorpus:
         else:
             slot = int(np.argmin(self._weight))
             self._slot_of.pop(self._digest_of.pop(slot, ""), None)
+        self._note_undo(slot)
         buf = np.zeros(self.words * 4, dtype=np.uint8)
         buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
         self._data[slot] = buf.view(np.uint32)
@@ -94,4 +105,92 @@ class DeviceCorpus:
                          jnp.asarray(self.cumulative_weights()))
             self._dirty = False
             synced = True
+            # device now matches the host slab: new undo baseline
+            self._undo.clear()
+            self._uploaded_count = self.count
         return (*self._dev, synced)
+
+    # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
+    def _note_undo(self, slot: int) -> None:
+        """Record `slot`'s pre-image before its first mutation since the
+        last upload (see _undo in __init__)."""
+        if slot not in self._undo:
+            self._undo[slot] = (self._data[slot].copy(),
+                                int(self._len[slot]),
+                                int(self._weight[slot]))
+
+    def uploaded_state(self) -> dict:
+        """The slab exactly as the device last saw it (undo applied over
+        the current host slab) — what a prelaunched batch was generated
+        from.  Rows are truncated at the upload-time slot count."""
+        data = self._data.copy()
+        lens = self._len.copy()
+        weight = self._weight.copy()
+        for slot, (d, ln, wt) in self._undo.items():
+            data[slot] = d
+            lens[slot] = ln
+            weight[slot] = wt
+        return {"count": self._uploaded_count, "data": data,
+                "lens": lens, "weight": weight}
+
+    def checkpoint_state(self) -> dict:
+        """Both slab views a resumable campaign needs: `current` (the
+        host-authoritative slab with digests — future evolution) and
+        `uploaded` (what the in-flight prelaunched batch sampled)."""
+        return {
+            "current": {
+                "count": self.count,
+                "data": self._data.copy(),
+                "lens": self._len.copy(),
+                "weight": self._weight.copy(),
+                "digests": [(slot, digest)
+                            for slot, digest in sorted(
+                                self._digest_of.items())],
+            },
+            "uploaded": self.uploaded_state(),
+        }
+
+    def restore(self, state: dict) -> None:
+        """Install a checkpoint_state(): host slab = `current`, device
+        arrays = `uploaded` (so the pending batch regenerates from the
+        exact slab it originally sampled), with the undo log rebuilt as
+        the diff between the two — a checkpoint taken before the next
+        upload still reconstructs `uploaded` faithfully."""
+        import jax.numpy as jnp
+
+        cur, up = state["current"], state["uploaded"]
+        shape = tuple(np.asarray(cur["data"]).shape)
+        if shape != (self.slots, self.words):
+            raise ValueError(
+                f"devmut slab shape mismatch: checkpoint {shape} vs "
+                f"configured ({self.slots}, {self.words}) — resume needs "
+                "the same slot count and max_len")
+        self._data = np.array(cur["data"], dtype=np.uint32)
+        self._len = np.array(cur["lens"], dtype=np.int32)
+        self._weight = np.array(cur["weight"], dtype=np.uint32)
+        self.count = int(cur["count"])
+        self._digest_of = {int(s): d for s, d in cur["digests"]}
+        self._slot_of = {d: s for s, d in self._digest_of.items()}
+        up_data = np.array(up["data"], dtype=np.uint32)
+        up_len = np.array(up["lens"], dtype=np.int32)
+        up_weight = np.array(up["weight"], dtype=np.uint32)
+        self._uploaded_count = int(up["count"])
+        cum = np.cumsum(up_weight, dtype=np.uint64).astype(np.uint32)
+        self._dev = (jnp.asarray(up_data), jnp.asarray(up_len),
+                     jnp.asarray(cum))
+        self._dirty = False
+        self._undo = {
+            slot: (up_data[slot].copy(), int(up_len[slot]),
+                   int(up_weight[slot]))
+            for slot in range(self.slots)
+            if (not np.array_equal(self._data[slot], up_data[slot])
+                or self._len[slot] != up_len[slot]
+                or self._weight[slot] != up_weight[slot])}
+
+    def mark_stale(self) -> None:
+        """Force the next arrays() call to re-upload the host slab.  The
+        restoring mutator calls this AFTER regenerating its pending batch
+        from the cached uploaded view — marking stale earlier would make
+        the regeneration re-upload the current slab and sample the wrong
+        corpus (see DevMangleMutator.restore_state)."""
+        self._dirty = True

@@ -174,6 +174,47 @@ class DevMangleMutator(Mutator):
         self.stats["fetched"] += len(lanes)
         return out
 
+    # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
+    def checkpoint_state(self) -> dict:
+        """Everything a bit-identical resume of the device stream needs:
+        the engine seed (drawn once from the campaign RNG at create time
+        — the restored run must NOT redraw), the batch cursor, whether a
+        prelaunched batch is in flight, and both slab views
+        (DeviceCorpus.checkpoint_state).  The byte stream is a pure
+        function of (seed, batch, lane, slab-as-uploaded), so this is
+        sufficient: the restore regenerates the pending batch instead of
+        persisting its bytes."""
+        if self.corpus is None:
+            raise RuntimeError("devmangle checkpoint before bind()")
+        return {
+            "seed": self.seed,
+            "batch": self._batch,
+            "pending": self._pending is not None,
+            "slab": self.corpus.checkpoint_state(),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Install a checkpoint into a freshly-bound mutator (bind() and
+        seed_from() already ran; their slab is discarded wholesale).
+        Regenerates the in-flight prelaunched batch from the slab view
+        the original run uploaded, then marks the slab stale so the next
+        prelaunch re-uploads the current (post-harvest) view — exactly
+        the upload the uninterrupted run would have paid."""
+        if self.corpus is None:
+            raise RuntimeError("devmangle restore before bind()")
+        self.seed = int(state["seed"]) & ((1 << 64) - 1)
+        self.corpus.restore(state["slab"])
+        self._current = None
+        self._pending = None
+        if state.get("pending"):
+            # _dispatch consumes the cached uploaded view and increments
+            # the cursor back to the checkpointed value
+            self._batch = int(state["batch"]) - 1
+            self._pending = self._dispatch()
+        else:
+            self._batch = int(state["batch"])
+        self.corpus.mark_stale()
+
     # -- Mutator contract --------------------------------------------------
     def on_new_coverage(self, testcase: bytes) -> None:
         self.corpus.add(testcase, weight=hostref.FAVOR_WEIGHT)

@@ -12,10 +12,12 @@ unmodified (the BASELINE.json north-star property).
   client  - node loop: run_testcase_and_restore over any Backend
 """
 
-from wtf_tpu.dist.client import BatchClient, Client, run_testcase_and_restore
+from wtf_tpu.dist.client import (
+    BatchClient, Client, MasterLink, run_testcase_and_restore,
+)
 from wtf_tpu.dist.server import Server, ServerStats
 
 __all__ = [
-    "BatchClient", "Client", "Server", "ServerStats",
+    "BatchClient", "Client", "MasterLink", "Server", "ServerStats",
     "run_testcase_and_restore",
 ]

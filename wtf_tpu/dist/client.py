@@ -20,14 +20,163 @@ Two node shapes:
 
 from __future__ import annotations
 
-import socket
+import logging
+import random
+import time
 from typing import List, Optional, Set, Tuple
 
 from wtf_tpu.core.results import TestcaseResult, Timedout
 from wtf_tpu.dist import wire
 from wtf_tpu.fuzz.loop import CampaignStats
 from wtf_tpu import telemetry
-from wtf_tpu.telemetry import Registry
+from wtf_tpu.telemetry import NULL, Registry
+
+log = logging.getLogger(__name__)
+
+
+class MasterLink:
+    """One resilient master connection: dial + tagged hello, transparent
+    reconnect with jittered exponential backoff bounded by
+    `max_retry_secs` (0 = reference behavior: any loss ends the node).
+
+    The re-handshake story: on socket loss the master reclaims this
+    link's in-flight testcases (dist/server.py _drop) and re-serves them
+    elsewhere, so the link never resends anything — it reconnects, says
+    hello again, and asks for fresh work.  An unsent result is simply
+    abandoned: its testcase re-executes somewhere, the master counts it
+    once.  A TAG_BYE frame is the orderly end (budget done / drain) and
+    permanently stops reconnection."""
+
+    BACKOFF_BASE = 0.05
+    BACKOFF_CAP = 2.0
+
+    def __init__(self, address: str, n_slots: int = 1,
+                 max_retry_secs: float = 0.0,
+                 registry: Optional[Registry] = None, events=None,
+                 rng: Optional[random.Random] = None,
+                 tagged: bool = True):
+        self.address = address
+        self.n_slots = n_slots
+        self.max_retry_secs = max_retry_secs
+        self.registry = registry if registry is not None else Registry()
+        self.events = events if events is not None else NULL
+        self.rng = rng if rng is not None else random.Random()
+        # tagged=False = full legacy (v1) wire behavior against a master
+        # that predates WTF2: raw downstream frames, no BYE — and
+        # therefore NO reconnect (a clean close is indistinguishable
+        # from an orderly end on v1, so retrying would spin against a
+        # finished master).  The rolling-upgrade escape hatch
+        # (`fuzz --wire-v1`).
+        self.tagged = tagged
+        self.sock = None
+        self._bye = False
+
+    def connect(self, retry_for: float = 10.0) -> None:
+        """Initial dial + hello (the node-before-master startup race is
+        covered by wire.dial's own transient retry window)."""
+        self._drop_socket()  # never strand a previous fd
+        sock = wire.dial(self.address, retry_for=retry_for)
+        try:
+            wire.send_msg(sock, wire.encode_hello(self.n_slots,
+                                                  tagged=self.tagged))
+        except OSError:
+            # hello lost with the connection (master died between accept
+            # and read — the crash-loop shape): close, don't leak the fd
+            # once per backoff attempt
+            sock.close()
+            raise
+        self.sock = sock
+
+    def close(self) -> None:
+        if self.sock is not None:
+            self.sock.close()
+            self.sock = None
+
+    def _drop_socket(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def _reconnect(self) -> bool:
+        """Backoff-reconnect within the retry budget; True once the
+        re-handshake landed.  Every attempt is a `dist.retries` count and
+        a `retry` event — the fleet's flap rate is an ops signal."""
+        if self.max_retry_secs <= 0 or self._bye or not self.tagged:
+            return False
+        deadline = time.monotonic() + self.max_retry_secs
+        delay = self.BACKOFF_BASE
+        attempt = 0
+        while time.monotonic() < deadline:
+            attempt += 1
+            self.registry.counter("dist.retries").inc()
+            self.events.emit("retry", attempt=attempt,
+                             address=self.address)
+            try:
+                self.connect(retry_for=0.0)
+            except OSError:
+                # jittered exponential backoff: a thousand nodes losing
+                # one master must not reconnect in lockstep
+                remaining = deadline - time.monotonic()
+                sleep = min(delay, max(remaining, 0.0)) \
+                    * (0.5 + self.rng.random() * 0.5)
+                if sleep > 0:
+                    time.sleep(sleep)
+                delay = min(delay * 2, self.BACKOFF_CAP)
+                continue
+            log.warning("reconnected to master after %d attempt(s)",
+                        attempt)
+            self.events.emit("reconnect", attempts=attempt,
+                             address=self.address)
+            return True
+        log.warning("master gone for > %.1fs; giving up",
+                    self.max_retry_secs)
+        return False
+
+    def recv_work(self) -> Optional[bytes]:
+        """The next work payload (testcase, or batch frame for mux
+        links); None = campaign over — BYE received, or the connection
+        died and the retry budget is spent."""
+        while True:
+            if self.sock is None and not self._reconnect():
+                return None
+            try:
+                if self.tagged:
+                    got = wire.recv_tagged(self.sock)
+                else:
+                    payload = wire.recv_msg(self.sock)
+                    got = (None if payload is None
+                           else (wire.TAG_WORK, payload))
+            except (OSError, ValueError):
+                got = None  # reset / desynced frame
+            if got is None:
+                # lost mid-campaign (or master closed without BYE, which
+                # for a retrying node means "maybe it restarts")
+                self._drop_socket()
+                if not self._reconnect():
+                    return None
+                continue
+            tag, payload = got
+            if tag == wire.TAG_BYE:
+                self._bye = True
+                self._drop_socket()
+                return None
+            return payload
+
+    def send(self, body: bytes) -> bool:
+        """Best-effort result send.  On failure the socket drops and the
+        result is abandoned (see class docstring); the next recv_work
+        reconnects.  Returns False when the send was lost."""
+        if self.sock is None:
+            return False
+        try:
+            wire.send_msg(self.sock, body)
+            return True
+        except OSError:
+            self._drop_socket()
+            return False
 
 
 class _NodeTelemetry:
@@ -63,43 +212,50 @@ def run_testcase_and_restore(backend, target, data: bytes,
 
 
 class Client(_NodeTelemetry):
-    """Single-slot node (reference shape)."""
+    """Single-slot node (reference shape).  `max_retry_secs` > 0 makes it
+    survive mid-campaign socket loss: reconnect with jittered backoff,
+    re-handshake, keep serving — a BYE (or the budget running out) still
+    ends it, so the reference's 'master gone -> node exits' remains the
+    terminal behavior (client.cc:228-231)."""
 
     def __init__(self, backend, target, address: str,
                  registry: Optional[Registry] = None, events=None,
-                 stats_every: float = 10.0, print_stats: bool = False):
+                 stats_every: float = 10.0, print_stats: bool = False,
+                 max_retry_secs: float = 0.0,
+                 retry_rng: Optional[random.Random] = None,
+                 wire_v1: bool = False):
         self.backend = backend
         self.target = target
         self.address = address
+        self.max_retry_secs = max_retry_secs
+        self.retry_rng = retry_rng
+        self.wire_v1 = wire_v1
         self.runs = 0
         self._init_telemetry(backend, registry, events, stats_every,
                              print_stats)
 
     def run(self, max_runs: int = 0) -> int:
-        """Serve until the master closes (or max_runs served)."""
+        """Serve until the master says BYE / stays gone (or max_runs)."""
         self.target.init(self.backend)
-        sock = wire.dial(self.address, retry_for=10.0)
-        wire.send_msg(sock, wire.encode_hello(1))
+        link = MasterLink(self.address, 1, self.max_retry_secs,
+                          registry=self.registry, events=self.events,
+                          rng=self.retry_rng, tagged=not self.wire_v1)
+        link.connect(retry_for=10.0)
         try:
             while max_runs == 0 or self.runs < max_runs:
-                try:
-                    testcase = wire.recv_msg(sock)
-                except (OSError, ValueError):
-                    break  # reset or desynced frame: same as master gone
+                testcase = link.recv_work()
                 if testcase is None:
-                    break  # master gone: node exits (client.cc:228-231)
+                    break  # campaign over / master gone for good
                 result, coverage = run_testcase_and_restore(
                     self.backend, self.target, testcase)
                 self.stats.account(result)
-                try:
-                    wire.send_msg(
-                        sock, wire.encode_result(testcase, coverage, result))
-                except OSError:
-                    break  # master hung up mid-report (shutdown race)
+                # a lost result is fine: the master reclaimed this
+                # testcase with the socket and re-serves it elsewhere
+                link.send(wire.encode_result(testcase, coverage, result))
                 self.runs += 1
                 self._heartbeat()
         finally:
-            sock.close()
+            link.close()
         return self.runs
 
 
@@ -118,84 +274,91 @@ class BatchClient(_NodeTelemetry):
 
     def __init__(self, backend, target, address: str, mux: bool = False,
                  registry: Optional[Registry] = None, events=None,
-                 stats_every: float = 10.0, print_stats: bool = False):
+                 stats_every: float = 10.0, print_stats: bool = False,
+                 max_retry_secs: float = 0.0,
+                 retry_rng: Optional[random.Random] = None,
+                 wire_v1: bool = False):
         self.backend = backend
         self.target = target
         self.address = address
         self.mux = mux
+        self.max_retry_secs = max_retry_secs
+        self.retry_rng = retry_rng
+        self.wire_v1 = wire_v1
         self.rounds = 0
         self.runs = 0
         self._init_telemetry(backend, registry, events, stats_every,
                              print_stats)
 
+    def _link(self, n_slots: int) -> MasterLink:
+        return MasterLink(self.address, n_slots, self.max_retry_secs,
+                          registry=self.registry, events=self.events,
+                          rng=self.retry_rng, tagged=not self.wire_v1)
+
     def run(self, max_rounds: int = 0) -> int:
         if self.mux:
             return self._run_mux(max_rounds)
         self.target.init(self.backend)
-        n = self.backend.n_lanes
-        socks: List[socket.socket] = []
-        for _ in range(n):
-            sock = wire.dial(self.address, retry_for=10.0)
-            wire.send_msg(sock, wire.encode_hello(1))
-            socks.append(sock)
+        links: List[MasterLink] = []
+        for _ in range(self.backend.n_lanes):
+            link = self._link(1)
+            link.connect(retry_for=10.0)
+            links.append(link)
         try:
             while max_rounds == 0 or self.rounds < max_rounds:
                 batch: List[bytes] = []
-                live: List[socket.socket] = []
-                for sock in socks:
-                    try:
-                        tc = wire.recv_msg(sock)
-                    except (OSError, ValueError):
-                        tc = None  # reset/desynced: lane's master is gone
+                live: List[MasterLink] = []
+                for link in links:
+                    tc = link.recv_work()  # reconnects under the hood
                     if tc is None:
-                        sock.close()  # lane retired: don't leak the fd
+                        link.close()  # lane retired (BYE / budget spent)
+                        if not link._bye:
+                            # this lane burned its WHOLE retry budget:
+                            # the master is gone for every lane — zero
+                            # the siblings' budgets so shutdown costs
+                            # one window, not n_lanes windows (they
+                            # still drain whatever their live sockets
+                            # already hold)
+                            for rest in links:
+                                rest.max_retry_secs = 0.0
                         continue
                     batch.append(tc)
-                    live.append(sock)
+                    live.append(link)
                 if not batch:
                     break
-                socks = live
+                links = live
                 results = self.backend.run_batch(batch, self.target)
-                kept: List[socket.socket] = []
-                for lane, (sock, data, result) in enumerate(
-                        zip(socks, batch, results)):
+                for lane, (link, data, result) in enumerate(
+                        zip(links, batch, results)):
                     coverage = self.backend.lane_coverage(lane)
                     if isinstance(result, Timedout):
                         coverage = set()  # revoked (client.cc:122-125)
                     elif not self.backend.lane_found_new_coverage(lane):
                         coverage = set()  # nothing new to report
                     self.stats.account(result)
-                    try:
-                        wire.send_msg(
-                            sock, wire.encode_result(data, coverage, result))
-                    except OSError:
-                        sock.close()  # master hung up mid-report
-                        continue
-                    kept.append(sock)
+                    # lost sends abandon the result (master reclaims);
+                    # the lane stays — its next recv_work reconnects
+                    link.send(wire.encode_result(data, coverage, result))
                     self.runs += 1
-                socks = kept
                 self.target.restore()
                 self.backend.restore()
                 self.rounds += 1
                 self._heartbeat()
         finally:
-            for sock in socks:
-                sock.close()
+            for link in links:
+                link.close()
         return self.runs
 
     def _run_mux(self, max_rounds: int = 0) -> int:
         """Multiplexed rounds: one batch frame in, one batch frame out."""
         self.target.init(self.backend)
-        sock = wire.dial(self.address, retry_for=10.0)
-        wire.send_msg(sock, wire.encode_hello(self.backend.n_lanes))
+        link = self._link(self.backend.n_lanes)
+        link.connect(retry_for=10.0)
         try:
             while max_rounds == 0 or self.rounds < max_rounds:
-                try:
-                    frame = wire.recv_msg(sock)
-                except (OSError, ValueError):
-                    break  # reset or desynced frame: master gone
+                frame = link.recv_work()
                 if frame is None:
-                    break
+                    break  # campaign over / master gone for good
                 batch = wire.decode_batch(frame)
                 if not batch:
                     break
@@ -211,14 +374,11 @@ class BatchClient(_NodeTelemetry):
                     replies.append(
                         wire.encode_result(data, coverage, result))
                     self.runs += 1
-                try:
-                    wire.send_msg(sock, wire.encode_batch(replies))
-                except OSError:
-                    break  # master hung up mid-report
+                link.send(wire.encode_batch(replies))
                 self.target.restore()
                 self.backend.restore()
                 self.rounds += 1
                 self._heartbeat()
         finally:
-            sock.close()
+            link.close()
         return self.runs

@@ -32,10 +32,12 @@ from typing import Deque, Dict, List, Optional, Set
 
 from wtf_tpu.core.results import OverlayFull
 from wtf_tpu.dist import wire
+from wtf_tpu.utils.atomicio import atomic_write_bytes, atomic_write_text
 from wtf_tpu.fuzz.corpus import Corpus
 from wtf_tpu.fuzz.loop import CampaignStats
 from wtf_tpu.fuzz.mutator import Mutator
 from wtf_tpu.telemetry import NULL, Registry
+from wtf_tpu.utils.hashing import hex_digest
 from wtf_tpu.utils.human import number_to_human, seconds_to_human
 
 log = logging.getLogger(__name__)
@@ -64,15 +66,18 @@ class ServerStats(CampaignStats):
 
 class _Conn:
     """Per-connection master state: slot count from the node's hello frame
-    (1 = reference shape; >1 = lane-multiplexed batch frames) and the
-    testcases in flight on it."""
+    (1 = reference shape; >1 = lane-multiplexed batch frames), the
+    testcases in flight on it, whether the node speaks tagged (v2)
+    frames, and when the in-flight batch was sent (reclaim timeout)."""
 
-    __slots__ = ("slots", "mux", "inflight")
+    __slots__ = ("slots", "mux", "inflight", "tagged", "since")
 
     def __init__(self):
         self.slots = 1
         self.mux = False
         self.inflight: List[bytes] = []
+        self.tagged = False
+        self.since = 0.0
 
 
 class Server:
@@ -90,6 +95,8 @@ class Server:
         coverage_path: Optional[Path] = None,
         registry: Optional[Registry] = None,
         events=None,
+        reclaim_timeout: float = 0.0,
+        drain_grace: float = 5.0,
     ):
         self.address = address
         self.mutator = mutator
@@ -131,6 +138,16 @@ class Server:
         self._listener: Optional[socket.socket] = None
         self._clients: Dict[socket.socket, _Conn] = {}
         self._sel: Optional[selectors.BaseSelector] = None
+        # fault tolerance: in-flight work of a dead or silent node is
+        # reclaimed to the pending deque (`dist.reclaimed`); 0 disables
+        # the silence timeout (drop-detection reclaim is always on)
+        self.reclaim_timeout = reclaim_timeout
+        # SIGTERM drain: stop serving, give in-flight results this long
+        # to land, persist, notify nodes, exit the reactor cleanly
+        self.drain_grace = drain_grace
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
+        self.drained = False
 
     @property
     def paths(self) -> Deque:
@@ -144,14 +161,39 @@ class Server:
         self._paths = deque(items)
 
     # -- testcase generation (server.h:629-714) ----------------------------
+    def _torn_corpus_file(self, path: Path, data: bytes) -> bool:
+        """outputs/ entries are content-addressed (name == digest): a
+        mismatch means the file was torn by a kill mid-write (pre-atomic
+        writers, or an external copy).  A restarted master must skip it
+        loudly, not replay garbage or abort the whole resume."""
+        if self.corpus.outputs_dir is None \
+                or path.parent != self.corpus.outputs_dir:
+            return False  # inputs/ names are operator-chosen: no contract
+        name = path.name
+        if len(name) != 64 or any(c not in "0123456789abcdef"
+                                  for c in name):
+            return False
+        # the SAME digest Corpus.add names outputs/ files with — an
+        # inline hash here would silently disagree if the content-digest
+        # choice ever changes, and then "skip torn files" would discard
+        # the entire persisted corpus on restart
+        return hex_digest(data) != name
+
     def _next_seed(self) -> Optional[bytes]:
         while self.paths:
             item = self.paths.popleft()
             if isinstance(item, Path):
                 try:
-                    return item.read_bytes()[:self.max_len]
+                    data = item.read_bytes()
                 except OSError:
                     continue  # vanished since the startup scan
+                if self._torn_corpus_file(item, data):
+                    log.warning("skipping torn corpus file %s "
+                                "(content fails its digest name)", item)
+                    self.events.emit("error", kind="torn-corpus-file",
+                                     path=str(item), size=len(data))
+                    continue
+                return data[:self.max_len]
             return item[:self.max_len]
         return None
 
@@ -211,7 +253,10 @@ class Server:
                 self.crash_names.add(name)
                 if self.crashes_dir:
                     try:
-                        (self.crashes_dir / name).write_bytes(testcase)
+                        # atomic (tmp+fsync+rename): a kill mid-save must
+                        # not leave a torn repro under crashes/
+                        atomic_write_bytes(self.crashes_dir / name,
+                                           testcase)
                     except (OSError, ValueError) as e:
                         log.warning("crash save failed for %r: %s", name, e)
                         self.events.emit("error", kind="crash-save",
@@ -225,6 +270,32 @@ class Server:
                 self._ovf_requeued.add(digest)
                 self.paths.append(testcase)
 
+    # -- drain (SIGTERM) ---------------------------------------------------
+    def request_drain(self) -> None:
+        """Graceful-shutdown request (SIGTERM handler, or any thread):
+        stop serving new testcases, give in-flight results `drain_grace`
+        seconds to land, persist, notify nodes (BYE on tagged
+        connections), and return from run() with `drained` set.  Safe to
+        call from a signal handler — it only flips a flag the reactor
+        polls."""
+        self._draining = True
+
+    def _drain_step(self, now: float) -> bool:
+        """True when the drain is complete and the reactor should exit."""
+        if self._drain_deadline is None:
+            self._drain_deadline = now + self.drain_grace
+            outstanding = sum(len(c.inflight)
+                              for c in self._clients.values())
+            log.warning("drain requested: %d client(s), %d in-flight "
+                        "testcase(s), grace %.1fs",
+                        len(self._clients), outstanding, self.drain_grace)
+            self.events.emit("drain", clients=len(self._clients),
+                             inflight=outstanding,
+                             grace_seconds=self.drain_grace)
+        if not any(c.inflight for c in self._clients.values()):
+            return True
+        return now > self._drain_deadline
+
     # -- reactor (server.h:361-598) ----------------------------------------
     def run(self, max_seconds: Optional[float] = None) -> ServerStats:
         """Event loop on `selectors` (epoll on Linux) — unlike the
@@ -236,11 +307,16 @@ class Server:
         self._sel = selectors.DefaultSelector()
         self._sel.register(self._listener, selectors.EVENT_READ)
         deadline = time.time() + max_seconds if max_seconds else None
+        restore_sigterm = self._install_sigterm()
         try:
             while True:
                 if self.done():
                     break
-                if deadline and time.time() > deadline:
+                now = time.time()
+                if self._draining and self._drain_step(now):
+                    self.drained = True
+                    break
+                if deadline and now > deadline:
                     break
                 for key, events in self._sel.select(timeout=0.5):
                     sock = key.fileobj
@@ -258,6 +334,8 @@ class Server:
                             and sock in self._clients):
                         self._on_readable(sock)
                 now = time.time()
+                if self.reclaim_timeout:
+                    self._reclaim_silent(now)
                 if (self._dirwatch is not None
                         and now - self._dirwatch_last >= 1.0):
                     # throttled: a directory scan per reactor pass would
@@ -274,7 +352,15 @@ class Server:
                     self.paths.extendleft(reversed(injected))
                 self._maybe_print()
         finally:
-            for sock in list(self._clients):
+            restore_sigterm()
+            for sock, conn in list(self._clients.items()):
+                # orderly end (budget done / drain): tell v2 nodes not to
+                # reconnect-retry against the closing listener
+                if conn.tagged:
+                    try:
+                        wire.send_bye(sock)
+                    except OSError:
+                        pass
                 sock.close()
             self._clients.clear()
             self._sel.close()
@@ -283,6 +369,40 @@ class Server:
             self._listener = None
             self._write_coverage()
         return self.stats
+
+    def _install_sigterm(self):
+        """SIGTERM -> request_drain, main thread only (signal.signal
+        raises elsewhere; threaded embedders call request_drain
+        directly).  Returns a restore callable for the finally block."""
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+        previous = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            self.request_drain()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            return lambda: None
+        return lambda: signal.signal(signal.SIGTERM, previous)
+
+    def _reclaim_silent(self, now: float) -> None:
+        """Heartbeat-timeout reclaim: a node holding in-flight testcases
+        in silence past `reclaim_timeout` is presumed dead (wedged chip,
+        half-open TCP after a power cut) — its work goes back to the
+        pending deque and the connection drops.  A merely-slow node
+        reconnects and keeps serving; its late results are simply lost
+        with the closed socket, so nothing double-counts."""
+        for sock, conn in list(self._clients.items()):
+            if conn.inflight and now - conn.since > self.reclaim_timeout:
+                log.warning("reclaiming %d testcase(s) from silent node "
+                            "(%.1fs > %.1fs timeout)", len(conn.inflight),
+                            now - conn.since, self.reclaim_timeout)
+                self._drop(sock, reason="timeout")
 
     def _write_coverage(self) -> None:
         """Persist the aggregate coverage in the .cov JSON shape
@@ -295,8 +415,10 @@ class Server:
         import json
 
         try:
-            self.coverage_path.parent.mkdir(parents=True, exist_ok=True)
-            self.coverage_path.write_text(json.dumps({
+            # atomic (utils/atomicio): a kill mid-write must leave the
+            # previous coverage file intact, never a torn JSON — this is
+            # the file a resumed/offline analysis reads
+            atomic_write_text(self.coverage_path, json.dumps({
                 "name": "aggregate",
                 "addresses": sorted(self.coverage),
             }))
@@ -311,6 +433,11 @@ class Server:
 
     def _feed(self, sock: socket.socket) -> None:
         conn = self._clients[sock]
+        if self._draining:
+            # drain: no new work leaves the master; the node is told to
+            # go away for good (BYE) instead of reconnect-retrying
+            self._drop(sock, bye=True)
+            return
         batch: List[bytes] = []
         while len(batch) < conn.slots:
             testcase = self.get_testcase()
@@ -321,14 +448,15 @@ class Server:
             # no work at all (budget exhausted / seeds drained): close the
             # idle client now — a batch node would otherwise block on this
             # socket while the master waits for its siblings (tail deadlock)
-            self._drop(sock)
+            self._drop(sock, bye=True)
             return
         try:
             if conn.mux:
-                wire.send_msg(sock, wire.encode_batch(batch))
+                wire.send_work(sock, wire.encode_batch(batch), conn.tagged)
             else:
-                wire.send_msg(sock, batch[0])
+                wire.send_work(sock, batch[0], conn.tagged)
             conn.inflight = batch  # in-flight until their results return
+            conn.since = time.time()
             self._ever_served = True
             self._set_writable(sock, False)
         except OSError:
@@ -353,6 +481,7 @@ class Server:
         if n_slots is not None:
             conn.slots = max(1, n_slots)
             conn.mux = conn.slots > 1
+            conn.tagged = wire.hello_is_tagged(body)
             if not conn.inflight:
                 self._set_writable(sock, True)  # greeted: open for work
             return
@@ -382,11 +511,22 @@ class Server:
         conn.inflight = []
         self._set_writable(sock, True)
 
-    def _drop(self, sock: socket.socket) -> None:
-        # a dying client's in-flight testcases are re-served to others
+    def _drop(self, sock: socket.socket, bye: bool = False,
+              reason: str = "drop") -> None:
+        # a dying client's in-flight testcases are re-served to others —
+        # the reclaim that makes node death cost retransmission, not work
         conn = self._clients.pop(sock, None)
         if conn is not None and conn.inflight:
             self.paths.extendleft(reversed(conn.inflight))
+            self.registry.counter("dist.reclaimed").inc(len(conn.inflight))
+            self.events.emit("reclaim", count=len(conn.inflight),
+                             reason=reason)
+        if bye and conn is not None and conn.tagged:
+            # orderly goodbye: a v2 node stops reconnect-retrying
+            try:
+                wire.send_bye(sock)
+            except OSError:
+                pass
         try:
             self._sel.unregister(sock)
         except (KeyError, ValueError):

@@ -21,6 +21,7 @@ layout instead:
 
 from __future__ import annotations
 
+import errno
 import socket
 import struct
 from typing import Optional, Set, Tuple
@@ -64,11 +65,33 @@ def listen(address: str, backlog: int = 64) -> socket.socket:
     return sock
 
 
+# Connect errors worth retrying inside a dial window: the master not up
+# yet (refused / unix socket file missing) plus the transient network
+# conditions a rebooting master or flapping route produces.  Anything
+# else (bad address family, EACCES, ...) is a configuration error and
+# aborts immediately — retrying would just mask it for retry_for seconds.
+_TRANSIENT_ERRNOS = frozenset({
+    errno.ECONNREFUSED, errno.ECONNRESET, errno.ECONNABORTED,
+    errno.EHOSTUNREACH, errno.ENETUNREACH, errno.ENETDOWN,
+    errno.ETIMEDOUT, errno.EINTR, errno.EAGAIN,
+})
+
+
+def _transient_connect_error(e: OSError) -> bool:
+    if isinstance(e, (ConnectionRefusedError, FileNotFoundError,
+                      socket.timeout)):
+        return True
+    return e.errno in _TRANSIENT_ERRNOS
+
+
 def dial(address: str, timeout: Optional[float] = None,
          retry_for: float = 0.0) -> socket.socket:
     """Connect to a master.  `retry_for` seconds of connect retries cover
     the node-starts-before-master race (the reference leaves this to the
-    operator; nodes here are commonly spawned together with the master)."""
+    operator; nodes here are commonly spawned together with the master)
+    AND transient network failures (EHOSTUNREACH/ETIMEDOUT/EINTR/...) —
+    a blip must not abort a node that was told to keep trying.  Past the
+    deadline the last error re-raises."""
     import time
 
     family, addr = parse_address(address)
@@ -79,9 +102,10 @@ def dial(address: str, timeout: Optional[float] = None,
             sock.settimeout(timeout)
         try:
             sock.connect(addr)
-        except (ConnectionRefusedError, FileNotFoundError):
+        except OSError as e:
             sock.close()
-            if time.monotonic() >= deadline:
+            if not _transient_connect_error(e) \
+                    or time.monotonic() >= deadline:
                 raise
             time.sleep(0.05)
             continue
@@ -130,18 +154,58 @@ def recv_msg(sock: socket.socket) -> Optional[bytes]:
 # is architecturally 1 fd per core, server.h:386-389, and its select()
 # master caps out at FD_SETSIZE).
 
-HELLO_MAGIC = b"WTFH"
+HELLO_MAGIC = b"WTFH"    # v1: server->client frames are raw payloads
+HELLO2_MAGIC = b"WTF2"   # v2: server->client frames carry a 1-byte tag
+
+# v2 downstream frame tags.  v1 has no in-band way to distinguish "the
+# campaign is over, don't come back" from "the master died" — the raw
+# testcase payload can be any bytes, so nothing can ride in-band without
+# colliding.  A v2 hello opts the connection into tagged frames:
+#   TAG_WORK  payload = one testcase (slots == 1) or a batch frame (mux)
+#   TAG_BYE   orderly end (budget done / drain): do NOT reconnect
+# v1 clients (and any reference-shaped client) keep getting untagged
+# frames and learn about shutdown the way they always did: a close.
+TAG_WORK = 0
+TAG_BYE = 1
 
 
-def encode_hello(n_slots: int) -> bytes:
-    return HELLO_MAGIC + struct.pack("<I", n_slots)
+def encode_hello(n_slots: int, tagged: bool = False) -> bytes:
+    return (HELLO2_MAGIC if tagged else HELLO_MAGIC) \
+        + struct.pack("<I", n_slots)
 
 
 def decode_hello(body: bytes) -> Optional[int]:
-    """n_slots when `body` is a hello frame, else None."""
-    if len(body) == 8 and body[:4] == HELLO_MAGIC:
+    """n_slots when `body` is a hello frame (either version), else None."""
+    if len(body) == 8 and body[:4] in (HELLO_MAGIC, HELLO2_MAGIC):
         return struct.unpack_from("<I", body, 4)[0]
     return None
+
+
+def hello_is_tagged(body: bytes) -> bool:
+    """True when a hello frame opted into tagged downstream frames."""
+    return len(body) == 8 and body[:4] == HELLO2_MAGIC
+
+
+def send_work(sock: socket.socket, body: bytes, tagged: bool) -> None:
+    """Master->node work frame, tagged per the connection's hello."""
+    send_msg(sock, bytes((TAG_WORK,)) + body if tagged else body)
+
+
+def send_bye(sock: socket.socket) -> None:
+    """Orderly-shutdown frame (tagged connections only)."""
+    send_msg(sock, bytes((TAG_BYE,)))
+
+
+def recv_tagged(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
+    """Node-side receive on a tagged connection: (tag, payload), or None
+    when the peer closed.  An empty frame is a protocol violation on a
+    tagged link (every frame carries at least its tag byte)."""
+    body = recv_msg(sock)
+    if body is None:
+        return None
+    if not body:
+        raise ValueError("empty frame on tagged connection")
+    return body[0], body[1:]
 
 
 def encode_batch(items) -> bytes:

@@ -38,8 +38,21 @@ class Corpus:
         self._items.append(data)
         self.bytes_total += len(data)
         if self.outputs_dir:
-            (self.outputs_dir / digest).write_bytes(data)
+            # atomic: a campaign killed mid-save must not leave a torn
+            # outputs/ entry for the restarted master to replay (the
+            # file IS the persistence the resume path relies on)
+            from wtf_tpu.utils.atomicio import atomic_write_bytes
+
+            atomic_write_bytes(self.outputs_dir / digest, data)
         return True
+
+    def clear(self) -> None:
+        """Drop every in-memory testcase (checkpoint restore rebuilds the
+        corpus in manifest order).  Persisted outputs/ files stay — they
+        are content-addressed and the restore re-adds by digest."""
+        self._items.clear()
+        self._digests.clear()
+        self.bytes_total = 0
 
     def pick(self) -> Optional[bytes]:
         """Uniform random pick (corpus.h:89-102); None while empty."""

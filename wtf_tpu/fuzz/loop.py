@@ -143,6 +143,8 @@ class FuzzLoop:
         stats_every: float = 10.0,
         registry: Optional[Registry] = None,
         events=None,
+        checkpoint_dir: Optional[Path] = None,
+        checkpoint_every: int = 0,
     ):
         self.backend = backend
         self.target = target
@@ -173,6 +175,20 @@ class FuzzLoop:
         # genuinely needs more dirty pages than the lane has slots
         self._requeue: list = []
         self._requeue_digests = set()
+        # crash-safe checkpointing (wtf_tpu/resume): every
+        # `checkpoint_every` batches the minimal resumable state lands in
+        # `checkpoint_dir` atomically; a kill at any point costs at most
+        # one checkpoint interval, and --resume replays bit-identically
+        self.checkpoint_dir = Path(checkpoint_dir) if checkpoint_dir else None
+        self.checkpoint_every = checkpoint_every
+        self.batches_done = 0
+        if self.checkpoint_every and not hasattr(backend, "coverage_state"):
+            # fail at construction, not at the first cadence hit deep
+            # into a campaign (the checkpoint needs the batched backend's
+            # device state seams)
+            raise ValueError(
+                "checkpointing requires the batched tpu backend "
+                "(--backend=tpu); this backend has no coverage_state seam")
 
     def _account(self, data: bytes, result: TestcaseResult,
                  requeue: bool = False) -> int:
@@ -285,7 +301,21 @@ class FuzzLoop:
         new = name not in self.crash_names
         self.crash_names.add(name)
         if self.crashes_dir:
-            (self.crashes_dir / name).write_bytes(data)
+            from wtf_tpu.utils.atomicio import atomic_write_bytes
+
+            try:
+                # atomic (tmp+fsync+rename): a kill mid-save must not
+                # leave a torn repro, and a full disk must not abort the
+                # campaign from inside the harvest loop (same contract
+                # as the dist master's crash save)
+                atomic_write_bytes(self.crashes_dir / name, data)
+            except OSError as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "crash save failed for %r: %s", name, e)
+                self.events.emit("error", kind="crash-save", name=name,
+                                 detail=str(e))
         self.events.emit("crash", name=name, size=len(data), new=new)
 
     def _heartbeat(self, print_stats: bool) -> None:
@@ -329,10 +359,43 @@ class FuzzLoop:
         --runs=0 to `minset` instead, matching the reference)."""
         while runs == 0 or self.stats.testcases < runs:
             found = self.run_one_batch()
+            self.batches_done += 1
+            self._maybe_checkpoint()
             self._heartbeat(print_stats)
             if stop_on_crash and found:
                 break
         return self.stats
+
+    def _maybe_checkpoint(self) -> None:
+        """--checkpoint-every cadence: persist the resumable state at the
+        batch boundary (wtf_tpu/resume).  Best-effort like every other
+        persistence side channel — a full disk degrades checkpointing
+        with a warning + error event, it never aborts the campaign."""
+        if not (self.checkpoint_dir and self.checkpoint_every):
+            return
+        if self.batches_done % self.checkpoint_every:
+            return
+        from wtf_tpu.resume import save_campaign
+
+        spans = self.registry.spans
+        before = spans.seconds("checkpoint")
+        try:
+            with spans.span("checkpoint"):
+                info = save_campaign(self, self.checkpoint_dir)
+        except OSError as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "checkpoint write failed at batch %d: %s",
+                self.batches_done, e)
+            self.events.emit("error", kind="checkpoint-write",
+                             batch=self.batches_done, detail=str(e))
+            return
+        self.registry.counter("campaign.checkpoints").inc()
+        self.events.emit("checkpoint", batch=self.batches_done,
+                         bytes=info["bytes"], path=info["path"],
+                         seconds=round(spans.seconds("checkpoint")
+                                       - before, 4))
 
     def _coverage(self) -> int:
         try:

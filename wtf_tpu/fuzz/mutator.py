@@ -51,6 +51,22 @@ class Mutator:
         """Called when `testcase` produced new coverage; engines use it to
         seed cross-over (reference LibfuzzerMutator_t::SetCrossOverWith)."""
 
+    # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
+    # Engine-private state beyond the shared campaign RNG (which the
+    # checkpoint carries separately).  The default covers every host
+    # engine here and the native binding: the only such state is the
+    # cross-over seed.  Engines with more (devmut's slab + batch cursor)
+    # override both.
+
+    def checkpoint_state(self) -> dict:
+        cross = getattr(self, "_cross", None)
+        return {"cross": cross.hex() if cross else None}
+
+    def restore_state(self, state: dict) -> None:
+        if hasattr(self, "_cross"):
+            cross = state.get("cross")
+            self._cross = bytes.fromhex(cross) if cross else None
+
 
 class ByteMutator(Mutator):
     """One mutation per testcase, libFuzzer-dispatch style."""

@@ -726,6 +726,29 @@ class Runner:
 
         return make_generate(rounds)(data, lens, cumw, jnp.asarray(seeds))
 
+    # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
+    def checkpoint_state(self) -> dict:
+        """The runner state a campaign checkpoint must carry: the decode
+        cache in insertion order (coverage-bitmap bit i is cache entry i,
+        so restored aggregate bitmaps are meaningless without identical
+        indices) plus the SMC thrash counters that gate the per-rip
+        fallback cutover.  Machine state needs nothing — checkpoints are
+        taken at batch boundaries, where the machine is freshly
+        restored to the snapshot."""
+        return {
+            "cache": self.cache.checkpoint_entries(),
+            "smc_updates": dict(self._smc_updates),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Restore checkpoint_state() output into a freshly-initialized
+        runner (empty decode cache; breakpoints from target.init may
+        already be pending — add() re-arms them)."""
+        self.cache.restore_entries(state.get("cache", []))
+        self._smc_updates = {int(k): int(v)
+                             for k, v in state.get("smc_updates",
+                                                   {}).items()}
+
     # -- trace-capture hooks (ablate.py / bench.py / wtf_tpu.analysis) -----
     def executor_operands(self) -> Tuple:
         """(tab, image, machine, limit) — the chunk executor's positional

@@ -193,6 +193,37 @@ class DecodeCache:
         self._device = None
         return idx
 
+    # -- checkpoint/resume (wtf_tpu/resume) ------------------------------
+    def checkpoint_entries(self) -> list:
+        """Insertion-ordered entry snapshot: (rip, raw bytes, pfn0, pfn1)
+        per entry.  Coverage-bitmap bit i IS entry index i (insertion
+        order), so a resumed campaign must rebuild the cache with
+        identical indices before a restored aggregate bitmap means
+        anything.  Only the raw bytes are persisted — decode is
+        deterministic on bytes, so the restore re-decodes; SMC-updated
+        entries round-trip with their *current* bytes (update() keeps
+        uops/raw in sync), exactly the state the killed run held."""
+        out = []
+        for idx in range(self.count):
+            rip = int(self.rip[idx])
+            uop = self.uops[rip]
+            out.append((rip, uop.raw, int(self.pfn0[idx]),
+                        int(self.pfn1[idx])))
+        return out
+
+    def restore_entries(self, entries) -> None:
+        """Rebuild from checkpoint_entries() output.  Requires an empty
+        cache — replaying into a partially-filled one would shift every
+        entry index and silently scramble restored coverage bitmaps."""
+        if self.count:
+            raise RuntimeError(
+                "decode-cache restore needs an empty cache "
+                f"(has {self.count} entries)")
+        from wtf_tpu.cpu.decoder import decode
+
+        for rip, raw, pfn0, pfn1 in entries:
+            self.add(rip, decode(raw, rip), pfn0, pfn1)
+
     # -- breakpoints -----------------------------------------------------
     def set_breakpoint(self, gva: int) -> None:
         self.pending_bps.add(gva)

@@ -60,6 +60,14 @@ class MeshBackend(TpuBackend):
         self.registry.gauge("mesh.lanes_per_shard").set(
             self.n_lanes // self.mesh.size)
 
+    def restore_coverage_state(self, cov, edge) -> None:
+        """Checkpointed aggregates re-enter REPLICATED (the mesh merge's
+        placement contract) — a bare jnp.asarray would leave them on one
+        device and force a reshard inside every batch merge."""
+        rep = replicated_sharding(self.mesh)
+        self._agg_cov = jax.device_put(jnp.asarray(cov), rep)
+        self._agg_edge = jax.device_put(jnp.asarray(edge), rep)
+
     def print_run_stats(self) -> None:
         super().print_run_stats()
         print(f"[tpu] mesh: {self.mesh.size} devices x "

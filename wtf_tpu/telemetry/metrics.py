@@ -151,6 +151,35 @@ class Registry:
         {count,sum,min,max} for histograms."""
         return {name: m.dump() for name, m in sorted(self._metrics.items())}
 
+    # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
+    def counters_state(self, prefixes) -> Dict[str, object]:
+        """Counters/gauges under `prefixes` as {name: {kind, value}} —
+        the resumable half of the registry (histograms and span timers
+        are wall-clock observations of the killed process; they restart
+        from zero by design)."""
+        out: Dict[str, object] = {}
+        for name, metric in self._metrics.items():
+            if isinstance(metric, Histogram):
+                continue
+            if not any(name.startswith(p) for p in prefixes):
+                continue
+            kind = "g" if isinstance(metric, Gauge) else "c"
+            out[name] = {"kind": kind, "value": metric.dump()}
+        return out
+
+    def restore_counters(self, state: Dict[str, object]) -> None:
+        """Install counters_state() output (resume overwrites whatever the
+        fresh process accumulated during its own warmup)."""
+        for name, entry in state.items():
+            getter = self.gauge if entry.get("kind") == "g" else self.counter
+            metric = getter(name)
+            value = entry.get("value")
+            if isinstance(value, dict):
+                for label, v in value.items():
+                    metric.labels(label).set(v)
+            else:
+                metric.set(value)
+
 
 _GLOBAL: Optional[Registry] = None
 

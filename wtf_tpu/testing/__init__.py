@@ -1,0 +1,16 @@
+"""Deterministic fault-injection tooling for the fault-tolerance tier.
+
+Not shipped behavior — test/ops harnesses that exercise the recovery
+paths (dist reconnect/reclaim, checkpoint/resume, torn-file fallback)
+against the REAL seams, reproducibly:
+
+  faultinject  seeded fault schedules over the socket and checkpoint-I/O
+               seams (reset, partial send/recv, delay, ENOSPC,
+               kill-at-batch-N)
+  chaos_smoke  the `make chaos-smoke` end-to-end soak
+"""
+
+from wtf_tpu.testing.faultinject import (  # noqa: F401
+    ChaosSocket, FaultPlan, SimulatedKill, chaos_dialing,
+    chaos_checkpoint_io, fuzz_until_killed, tear_file,
+)
