@@ -6,9 +6,10 @@
 
 PY ?= python
 
-.PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke
+.PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke \
+	triage-smoke
 
-verify: test lint chaos-smoke
+verify: test lint chaos-smoke triage-smoke
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -38,6 +39,13 @@ mesh-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m wtf_tpu campaign --name demo_tlv --mesh-devices 8 \
 		--mutator devmangle --lanes 16 --runs 32 --limit 20000 --seed 7
+
+# batched-triage smoke (wtf_tpu/testing/triage_smoke): tiny demo_tlv
+# minimize + distill through the real CLI — the seeded crasher must
+# shrink to the known-minimal reproducer of the SAME crash bucket, and
+# the distilled minset must be a corpus subset with full coverage
+triage-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.triage_smoke
 
 # deterministic fault-tolerance soak (wtf_tpu/testing/chaos_smoke):
 # seeded fault schedule over the real socket + checkpoint seams —

@@ -158,6 +158,30 @@ def summarize(path) -> dict:
             "merged_instructions": metrics.get("device.instructions", 0),
         }
 
+    # triage (wtf_tpu/triage): candidate volume and what it bought —
+    # dispatches per minimization, bytes removed, minset before/after.
+    # None when the run did no triage work.
+    triage = None
+    tri_signals = {
+        "candidates": metrics.get("triage.candidates", 0) or 0,
+        "dispatches": metrics.get("triage.dispatches", 0) or 0,
+        "crashes_replayed": metrics.get("triage.crashes", 0) or 0,
+        "minimizations": metrics.get("triage.minimizations", 0) or 0,
+        "minimize_rounds": metrics.get("triage.minimize_rounds", 0) or 0,
+        "bytes_removed": metrics.get("triage.bytes_removed", 0) or 0,
+        "minset_before": metrics.get("triage.minset_before", 0) or 0,
+        "minset_after": metrics.get("triage.minset_after", 0) or 0,
+        "captures": metrics.get("triage.captures", 0) or 0,
+    }
+    if any(tri_signals.values()):
+        triage = dict(tri_signals)
+        if tri_signals["minimizations"]:
+            triage["dispatches_per_minimization"] = round(
+                tri_signals["dispatches"] / tri_signals["minimizations"], 2)
+        if wall and tri_signals["candidates"]:
+            triage["candidates_per_s"] = round(
+                tri_signals["candidates"] / wall, 2)
+
     # resilience (fault-tolerance tier): reconnect/reclaim/resume
     # activity + checkpoint cadence and cost.  None when the run had no
     # fault-tolerance signal at all — quiet campaigns stay quiet.
@@ -237,6 +261,7 @@ def summarize(path) -> dict:
                 else None),
         },
         "mesh": mesh,
+        "triage": triage,
         "resilience": resilience,
         "errors": errors,
     }
@@ -309,6 +334,25 @@ def _print_human(s: dict) -> None:
                           "DISAGREES)")
             print(f"  per-shard instructions: {per} "
                   f"(sum {mesh['shard_instructions_sum']}{agree})")
+    tri = s.get("triage")
+    if tri:
+        per_min = (f" ({tri['dispatches_per_minimization']} "
+                   "dispatches/minimization)"
+                   if "dispatches_per_minimization" in tri else "")
+        rate = (f" ({tri['candidates_per_s']}/s)"
+                if "candidates_per_s" in tri else "")
+        print(f"triage: candidates={tri['candidates']}{rate} "
+              f"dispatches={tri['dispatches']}{per_min} "
+              f"crashes={tri['crashes_replayed']}")
+        if tri["minimizations"]:
+            print(f"  minimize: {tri['minimizations']} run(s), "
+                  f"{tri['minimize_rounds']} rounds, "
+                  f"{tri['bytes_removed']} bytes removed")
+        if tri["minset_before"]:
+            print(f"  distill: minset {tri['minset_before']} -> "
+                  f"{tri['minset_after']} seeds")
+        if tri["captures"]:
+            print(f"  vbreak: {tri['captures']} captures")
     res = s.get("resilience")
     if res:
         ckpt = (f", checkpoints={res['checkpoints']} "

@@ -14,7 +14,9 @@ the result:
   budget    data-dependent gather/dynamic-slice/dynamic-update-slice/
             scatter ops surviving in the compiled step ladder, pinned
             against analysis/budgets.json (the PERF.md round-8 "168
-            surviving kernels" math as a regression gate)
+            surviving kernels" math as a regression gate); plus the
+            triage-chunk identity pin — wtf_tpu/triage's replay core
+            must dispatch this same ladder (zero new kernels)
   recompile re-trace the executor under perturbed-but-same-shape inputs
             and flag signature instability; weak-typed executor operands
             (a python scalar passed where a committed dtype belongs —
@@ -219,6 +221,21 @@ def _dtype_arg_recipes() -> Dict[str, Tuple]:
             lambda d, ln, c, s: DM.generate(d, ln, c, s, rounds=1),
             (dm_data, dm_lens, dm_cumw, dm_seeds)),
     })
+    # triage candidate builds (triage.PORTED_LIMB_PATHS): the in-graph
+    # minimizer ops run under the same pin as the devmut engine
+    from wtf_tpu.triage import candidates as TC
+
+    tc_words = jnp.zeros((8,), jnp.uint32)
+    tc_ops = jnp.zeros((2,), jnp.int32)
+    tc_u = jnp.zeros((2,), jnp.uint32)
+    recipes.update({
+        "triage.build_candidates": (
+            TC.build_candidates,
+            (tc_words, jnp.uint32(7), tc_ops, tc_u, tc_u)),
+        "triage.zero_counts": (
+            TC.zero_counts,
+            (jnp.zeros((2, 8), jnp.uint32), jnp.ones((2,), jnp.int32))),
+    })
     return recipes
 
 
@@ -236,9 +253,11 @@ def run_dtype_family(exports: Optional[Dict] = None,
     from wtf_tpu.devmut import engine as DM
     from wtf_tpu.interp import limbs as L
     from wtf_tpu.interp import step as S
+    from wtf_tpu.triage import candidates as TC
 
     if exports is None:
-        exports = {**S.PORTED_LIMB_PATHS, **DM.PORTED_LIMB_PATHS}
+        exports = {**S.PORTED_LIMB_PATHS, **DM.PORTED_LIMB_PATHS,
+                   **TC.PORTED_LIMB_PATHS}
     recipes = _dtype_arg_recipes()
     findings: List[Finding] = []
     for name in sorted(exports):
@@ -316,6 +335,41 @@ def check_budget(counts: Dict[str, int], budget: Dict[str, int],
                      "intentional, re-baseline with `python -m "
                      "wtf_tpu.analysis --rebaseline` and record why in "
                      "PERF.md")))
+    return findings
+
+
+def check_triage_chunk() -> List[Finding]:
+    """The triage replay core must dispatch the SAME compiled step
+    ladder the campaign runs — zero new gather/DS/DUS kernels beyond the
+    pinned budget.  Statically: its declared chunk-executor factory is
+    step.make_run_chunk by identity (ReplayCore drives Runner.run, whose
+    `_chunk_callable` memoizes that factory), and the core defines no
+    private executor seam.  Re-pointing either is a real kernel-budget
+    event and must be re-baselined consciously."""
+    from wtf_tpu.interp.step import make_run_chunk
+    from wtf_tpu.triage import replay as TR
+
+    findings = []
+    if TR.REPLAY_CHUNK_FACTORY is not make_run_chunk:
+        findings.append(Finding(
+            rule="budget.triage-chunk", entry="triage.replay",
+            primitive="REPLAY_CHUNK_FACTORY",
+            message=("triage's replay chunk no longer resolves to "
+                     "step.make_run_chunk — the triage path would "
+                     "compile its own step program outside the pinned "
+                     "168-kernel budget; route it through the Runner "
+                     "dispatch seam or re-baseline")))
+    private = [name for name in ("_chunk_callable", "chunk_executor",
+                                 "device_tab")
+               if name in vars(TR.ReplayCore)]
+    if private:
+        findings.append(Finding(
+            rule="budget.triage-chunk", entry="triage.replay.ReplayCore",
+            primitive=", ".join(private),
+            message=("ReplayCore overrides the Runner dispatch seam — "
+                     "triage batches must run the campaign's own chunk "
+                     "executors (budget + mesh census coverage), not a "
+                     "private program")))
     return findings
 
 
@@ -689,6 +743,9 @@ def run_lint(families: Optional[Sequence[str]] = None,
                                          entry=info["entries"][0]))
         for name, value in counts.items():
             registry.gauge("analysis.kernel_count").labels(name).set(value)
+        # the triage replay core rides the same compiled ladder: its
+        # kernel contribution is ZERO by identity, checked statically
+        findings.extend(check_triage_chunk())
         info["seconds"]["budget"] = round(time.time() - t0, 1)
 
     if "recompile" in families:

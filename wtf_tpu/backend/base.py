@@ -85,6 +85,16 @@ class Backend(abc.ABC):
     @abc.abstractmethod
     def set_rip(self, value: int) -> None: ...
 
+    def get_rflags(self) -> int:
+        """Current-lane RFLAGS (triage introspection: the vbreak capture
+        snapshots it alongside the GPR file)."""
+        raise NotImplementedError
+
+    def get_icount(self) -> int:
+        """Instructions retired by the current lane this run (triage
+        introspection; 0-based at insert time)."""
+        raise NotImplementedError
+
     def __getattr__(self, name):
         # rax()/rcx()/... accessor-mutator shortcuts (backend.cc:241-307)
         if name in _REG_IDX:

@@ -212,6 +212,12 @@ class EmuBackend(Backend):
     def set_rip(self, value: int) -> None:
         self.cpu.rip = value & (1 << 64) - 1
 
+    def get_rflags(self) -> int:
+        return self.cpu.rflags
+
+    def get_icount(self) -> int:
+        return self.cpu.icount
+
     # -- memory ------------------------------------------------------------
     def virt_translate(self, gva: int, write: bool = False) -> int:
         return self.cpu.translate(gva, write)

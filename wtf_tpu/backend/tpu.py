@@ -183,6 +183,19 @@ class TpuBackend(Backend):
         calls — mutate→insert→execute with no host round-trip for the
         testcase bytes.  `mutator` is a bound DevMangleMutator whose
         take_batch() already ran; every lane is active."""
+        words, lens = mutator.current_batch()
+        spec = mutator.spec
+        return self.run_batch_words(words, lens, mutator.pfns, spec)
+
+    def run_batch_words(self, words, lens, pfns,
+                        spec) -> List[TestcaseResult]:
+        """The device-generated batch driver shared by the devmangle fuzz
+        path and the triage replay core (wtf_tpu/triage): `words`
+        (u32[L, W]) / `lens` (i32[L]) device arrays — a devmut generate
+        output, or triage's in-graph candidate builds — land in every
+        lane's overlay through Runner.device_insert and the batch runs
+        with every lane active.  `spec` is the target's
+        DeviceInsertSpec, `pfns` the input region's page frames."""
         runner = self.runner
         runner.limit = self.limit
         self._lane_results = {}
@@ -196,9 +209,7 @@ class TpuBackend(Backend):
                 runner.push(self._view)
                 self._view = None
             with spans.span("device") as sp:
-                words, lens = mutator.current_batch()
-                spec = mutator.spec
-                runner.device_insert(words, lens, mutator.pfns, spec.gva,
+                runner.device_insert(words, lens, pfns, spec.gva,
                                      spec.len_gpr, spec.ptr_gpr)
                 sp.fence(runner.machine.status)
         statuses = runner.run(bp_handler=self._dispatch_bp)
@@ -381,6 +392,12 @@ class TpuBackend(Backend):
 
     def set_rip(self, value: int) -> None:
         self._ensure_view().set_rip(self._lane, value)
+
+    def get_rflags(self) -> int:
+        return int(self._ensure_view().r["rflags"][self._lane])
+
+    def get_icount(self) -> int:
+        return int(self._ensure_view().r["icount"][self._lane])
 
     def virt_translate(self, gva: int, write: bool = False) -> int:
         return self._ensure_view().translate(self._lane, gva, write)

@@ -11,6 +11,11 @@ defaulting) and subcommands.cc:16-101 (drivers):
             (MasterSubcommand -> Server_t::Run, subcommands.cc:99-101)
   campaign  single-process fused master+node over one device batch
             (this framework's native mode; no reference equivalent)
+  triage    batched crash triage on the device batch (wtf_tpu/triage):
+            minimize (crash bisection), distill (exact-attribution
+            corpus minset), vbreak (virtual-breakpoint replay) — the
+            reference's host-serial `run`-mode workflows as mesh
+            dispatches
   lint      graph-invariant static analysis of the hot-path contracts
             (wtf_tpu/analysis; CPU-only, no reference equivalent)
 
@@ -34,7 +39,7 @@ from typing import List, Optional
 
 from wtf_tpu.config import (
     BACKENDS, CampaignOptions, DEFAULT_ADDRESS, FuzzOptions, MasterOptions,
-    RunOptions, TargetPaths, TRACE_TYPES,
+    RunOptions, TargetPaths, TRACE_TYPES, TriageOptions,
 )
 from wtf_tpu.core.results import Crash
 from wtf_tpu.harness.targets import Targets, load_builtin_targets
@@ -219,6 +224,77 @@ def build_parser() -> argparse.ArgumentParser:
     camp.add_argument("--process-id", type=int, default=None)
     _add_backend_tuning(camp, mesh=True)
 
+    triage = sub.add_parser(
+        "triage", help="batched crash triage on the device batch "
+                       "(wtf_tpu/triage): minimize / distill / vbreak")
+    tsub = triage.add_subparsers(dest="triage_cmd", required=True)
+
+    tmin = tsub.add_parser(
+        "minimize", help="bisect a crasher to a minimal reproducer of "
+                         "the SAME crash bucket — thousands of in-graph "
+                         "candidate reductions per dispatch")
+    _add_target_selection(tmin)
+    _add_paths(tmin)
+    tmin.add_argument("--backend", choices=("tpu",), default="tpu")
+    tmin.add_argument("--input", type=Path, required=True,
+                      help="the crashing testcase")
+    tmin.add_argument("--output", type=Path, default=None,
+                      help="where the minimized reproducer lands "
+                           "(default: <input>.min)")
+    tmin.add_argument("--limit", type=int, default=0)
+    tmin.add_argument("--lanes", type=int, default=64,
+                      help="candidates per dispatch")
+    tmin.add_argument("--max-rounds", type=int, default=64)
+    _add_backend_tuning(tmin, mesh=True)
+
+    tdis = tsub.add_parser(
+        "distill", help="re-execute the corpus in one batched sweep, "
+                        "compute exact per-testcase edge attribution "
+                        "from the coverage bit-planes, and keep a "
+                        "set-cover subset with identical aggregate "
+                        "coverage (the exact-attribution minset)")
+    _add_target_selection(tdis)
+    _add_paths(tdis)
+    tdis.add_argument("--backend", choices=("tpu",), default="tpu")
+    tdis.add_argument("--from-checkpoint", type=Path, default=None,
+                      metavar="DIR",
+                      help="distill a campaign checkpoint's corpus "
+                           "(wtf_tpu/resume dir) instead of inputs/")
+    tdis.add_argument("--limit", type=int, default=0)
+    tdis.add_argument("--lanes", type=int, default=64)
+    _add_backend_tuning(tdis, mesh=True)
+
+    tvb = tsub.add_parser(
+        "vbreak", help="virtual-breakpoint replay: arm a breakpoint at "
+                       "a RIP/icount and capture a register+memory "
+                       "window per lane across (perturbed) replays")
+    _add_target_selection(tvb)
+    _add_paths(tvb)
+    tvb.add_argument("--backend", choices=BACKENDS, default="tpu",
+                     help="emu = the single-step oracle (debugging "
+                          "convenience; one replay at a time)")
+    tvb.add_argument("--input", type=Path, required=True,
+                     help="testcase file or directory")
+    tvb.add_argument("--break-at", required=True,
+                     help="capture point: hex address, symbol, or "
+                          "symbol+0xOFF")
+    tvb.add_argument("--hit", type=int, default=1,
+                     help="capture on the Nth arrival at the RIP")
+    tvb.add_argument("--min-icount", type=int, default=0,
+                     help="only capture once this many instructions "
+                          "retired (arrivals before resume past the bp)")
+    tvb.add_argument("--mem", default="",
+                     help="memory window GVA:LEN (hex ok; default: 64 "
+                          "bytes at rsp)")
+    tvb.add_argument("--variants", type=int, default=0,
+                     help="add N deterministic single-byte perturbations "
+                          "per input to the sweep")
+    tvb.add_argument("--out", type=Path, default=None,
+                     help="write captures as JSON")
+    tvb.add_argument("--limit", type=int, default=0)
+    tvb.add_argument("--lanes", type=int, default=64)
+    _add_backend_tuning(tvb, mesh=True)
+
     lint = sub.add_parser(
         "lint", help="graph-invariant static analysis of the hot-path "
                      "contracts (wtf_tpu/analysis; CPU-only, no chip)")
@@ -297,6 +373,35 @@ def _build_backend(target, backend_name: str, paths: TargetPaths,
     with registry.spans.span("init"):
         backend.initialize()
     return backend
+
+
+def _minset_seed_walk(paths: TargetPaths, corpus):
+    """The ONE minset measurement walk shared by `campaign --runs 0`
+    and `triage distill`: a single scan over inputs/ AND any prior
+    outputs/ feeds `corpus` (shared size-sorted replay ordering;
+    add_digested dedups) and snapshots outputs/ (pre-dedup census) so
+    it can end as exactly the kept subset of what was measured.
+    Returns the [(path, digest)] outputs snapshot."""
+    from wtf_tpu.fuzz.corpus import seed_paths
+
+    out_dir = Path(paths.outputs) if paths.outputs else None
+    outputs_snapshot = []
+    for p, digest, data in seed_paths([paths.inputs, paths.outputs],
+                                      with_data=True, keep_dups=True):
+        corpus.add_digested(data, digest)
+        if out_dir and p.parent == out_dir:
+            outputs_snapshot.append((p, digest))
+    return outputs_snapshot
+
+
+def _prune_outputs(outputs_snapshot, kept) -> None:
+    """outputs/ ends as exactly the kept subset of what was measured:
+    every snapshot file's content was replayed (directly or via a
+    content-identical twin), so prune by content digest.  Files that
+    appeared after the walk were never measured and stay untouched."""
+    for p, digest in outputs_snapshot:
+        if not (digest in kept.digests and p.name == digest):
+            p.unlink(missing_ok=True)
 
 
 def _mutator_for(target, rng: random.Random, max_len: int):
@@ -504,29 +609,11 @@ def cmd_campaign(args) -> int:
             # reference semantics (server.h:552-556): replay the seeds —
             # plus any prior campaign's outputs/, so a corpus can minimize
             # itself — and leave outputs/ holding exactly the
-            # coverage-minimal subset.  ONE walk feeds both the corpus
-            # (through the shared size-sorted replay-ordering policy;
-            # add_digested dedups) and the prune snapshot (pre-dedup census
-            # of outputs/); files appearing after this walk were never
-            # measured and stay untouched
-            from wtf_tpu.fuzz.corpus import seed_paths
-
-            out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
-            outputs_snapshot = []
-            for p, digest, data in seed_paths(
-                    [opts.paths.inputs, opts.paths.outputs],
-                    with_data=True, keep_dups=True):
-                corpus.add_digested(data, digest)
-                if out_dir and p.parent == out_dir:
-                    outputs_snapshot.append((p, digest))
+            # coverage-minimal subset (walk + prune shared with
+            # `triage distill`)
+            outputs_snapshot = _minset_seed_walk(opts.paths, corpus)
             kept = loop.minset(opts.paths.outputs, print_stats=True)
-            # outputs/ ends as exactly the kept subset of what was
-            # measured: every snapshot file's content was replayed
-            # (directly or via a content-identical twin), so prune by
-            # content digest
-            for p, digest in outputs_snapshot:
-                if not (digest in kept.digests and p.name == digest):
-                    p.unlink(missing_ok=True)
+            _prune_outputs(outputs_snapshot, kept)
             print(loop.stats.line(len(corpus), loop._coverage()))
             print(f"minset: kept {len(kept)}/{len(corpus)} seeds")
             return 0 if loop.stats.crashes == 0 else 2
@@ -534,6 +621,178 @@ def cmd_campaign(args) -> int:
                           stop_on_crash=opts.stop_on_crash)
         print(stats.line(len(corpus), loop._coverage()))
         return 0 if stats.crashes == 0 else 2
+
+
+def _parse_break_at(spec: str, symbols: dict) -> int:
+    """hex address, symbol, or symbol+0xOFF over the snapshot's symbol
+    store (the reference resolves bp sites the same way, backend.cc:
+    214-239)."""
+    base, _, off = spec.partition("+")
+    offset = int(off, 0) if off else 0
+    try:
+        return int(base, 0) + offset
+    except ValueError:
+        pass
+    if base in symbols:
+        return int(symbols[base]) + offset
+    raise SystemExit(
+        f"--break-at {spec!r}: not an address and not in the symbol "
+        f"store ({len(symbols)} symbols; e.g. {sorted(symbols)[:4]})")
+
+
+def _triage_inputs(path: Path) -> List[tuple]:
+    """[(name, bytes)] for a testcase file or directory."""
+    if path.is_dir():
+        return [(p.name, p.read_bytes())
+                for p in sorted(p for p in path.iterdir() if p.is_file())]
+    return [(path.name, path.read_bytes())]
+
+
+def cmd_triage(args) -> int:
+    """`wtf-tpu triage {minimize,distill,vbreak}` — the batched triage
+    engine (wtf_tpu/triage): replay variants at campaign throughput on
+    the same hardware, mesh-sharded under --mesh-devices."""
+    opts = TriageOptions(
+        name=args.name, cmd=args.triage_cmd, backend=args.backend,
+        input=getattr(args, "input", None),
+        output=getattr(args, "output", None),
+        limit=args.limit, lanes=args.lanes,
+        mesh_devices=getattr(args, "mesh_devices", None),
+        max_rounds=getattr(args, "max_rounds", 64),
+        from_checkpoint=getattr(args, "from_checkpoint", None),
+        break_at=getattr(args, "break_at", ""),
+        hit=getattr(args, "hit", 1),
+        min_icount=getattr(args, "min_icount", 0),
+        mem=getattr(args, "mem", ""), variants=getattr(args, "variants", 0),
+        out=getattr(args, "out", None), paths=_paths_from(args))
+    target = _lookup_target(args)
+    with _telemetry_for(args) as (registry, events):
+        backend = _build_backend(target, opts.backend, opts.paths,
+                                 opts.limit, opts.lanes,
+                                 registry=registry, events=events,
+                                 tuning=_backend_tuning_kwargs(args))
+        target.init(backend)
+        driver = {"minimize": _triage_minimize, "distill": _triage_distill,
+                  "vbreak": _triage_vbreak}[opts.cmd]
+        return driver(opts, backend, target, registry, events)
+
+
+def _triage_minimize(opts, backend, target, registry, events) -> int:
+    from wtf_tpu.triage import minimize
+
+    crasher = opts.input.read_bytes()
+    try:
+        result = minimize(backend, target, crasher,
+                          registry=registry, events=events,
+                          max_rounds=opts.max_rounds)
+    except ValueError as e:
+        print(f"minimize: {e}")
+        return 1
+    out = opts.output or opts.input.with_name(opts.input.name + ".min")
+    from wtf_tpu.utils.atomicio import atomic_write_bytes
+
+    atomic_write_bytes(out, result.data)
+    print(f"minimize: {result.from_len} -> {len(result.data)} bytes "
+          f"(bucket {result.bucket}; {result.rounds} rounds, "
+          f"{result.dispatches} dispatches, {result.candidates} "
+          f"candidates, {result.simplified} bytes zeroed)")
+    print(f"wrote {out}")
+    return 0
+
+
+def _triage_distill(opts, backend, target, registry, events) -> int:
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.triage import distill
+
+    out_dir = Path(opts.paths.outputs) if opts.paths.outputs else None
+    outputs_snapshot: List[tuple] = []
+    if opts.from_checkpoint:
+        # checkpoint-aware input: the campaign checkpoint's corpus, in
+        # manifest order with digests verified (wtf_tpu/resume).  The
+        # checkpoint is the measurement domain — pre-existing outputs/
+        # files were not measured and stay untouched.
+        from wtf_tpu.resume import load_campaign
+        from wtf_tpu.resume.checkpoint import restore_corpus
+
+        state, _ = load_campaign(opts.from_checkpoint)
+        source = Corpus()
+        restore_corpus(source, state, opts.from_checkpoint)
+    else:
+        # the campaign --runs 0 measurement walk + prune, shared with
+        # cmd_campaign so minset and distill can never drift on which
+        # outputs/ files they delete
+        source = Corpus()
+        outputs_snapshot = _minset_seed_walk(opts.paths, source)
+    if not len(source):
+        raise SystemExit("distill found no seeds (--inputs/--target "
+                         "dirs, or --from-checkpoint)")
+    testcases = list(source)
+    result = distill(backend, target, testcases,
+                     registry=registry, events=events)
+    kept = Corpus(outputs_dir=out_dir)
+    for idx in result.keep:
+        kept.add(testcases[idx])
+    _prune_outputs(outputs_snapshot, kept)
+    crashes = registry.counter("triage.crashes").value
+    print(f"distill: kept {len(result.keep)}/{len(testcases)} seeds "
+          f"(exact cover, {result.kept_bits}/{result.total_bits} bits; "
+          f"prefix minset would keep {len(result.prefix_keep)}; "
+          f"{registry.counter('triage.dispatches').value} dispatches, "
+          f"{crashes} crashes)")
+    if out_dir:
+        print(f"wrote minset to {out_dir}")
+    return 0
+
+
+def _triage_vbreak(opts, backend, target, registry, events) -> int:
+    import json
+
+    from wtf_tpu.triage import oracle_capture, perturbations, vbreak
+    from wtf_tpu.triage.bucket import TOS_BYTES
+
+    rip = _parse_break_at(opts.break_at, getattr(backend, "symbols", {}))
+    mem_gva, mem_len = None, TOS_BYTES
+    if opts.mem:
+        try:
+            gva_s, _, len_s = opts.mem.partition(":")
+            mem_gva = int(gva_s, 0)
+            mem_len = int(len_s, 0) if len_s else TOS_BYTES
+        except ValueError:
+            raise SystemExit(f"--mem {opts.mem!r}: expected GVA[:LEN] "
+                             "(hex ok, e.g. 0x7fffe000:128)")
+    named = _triage_inputs(opts.input)
+    testcases = []
+    for _, data in named:
+        testcases.extend(perturbations(data, opts.variants + 1))
+    try:
+        if opts.backend == "emu":
+            captures = [
+                oracle_capture(backend, target, data, rip, index=i,
+                               hit=opts.hit, min_icount=opts.min_icount,
+                               mem_gva=mem_gva, mem_len=mem_len)
+                for i, data in enumerate(testcases)]
+        else:
+            captures, _results = vbreak(
+                backend, target, testcases, rip, hit=opts.hit,
+                min_icount=opts.min_icount, mem_gva=mem_gva,
+                mem_len=mem_len, registry=registry, events=events)
+    except ValueError as e:
+        # e.g. the target's init already owns the breakpoint — the
+        # same clean one-liner the minimize subcommand gives
+        print(f"vbreak: {e}")
+        return 1
+    got = [c for c in captures if c is not None]
+    print(f"vbreak: {len(got)}/{len(testcases)} replays captured at "
+          f"{rip:#x} (hit {opts.hit})")
+    for c in got:
+        print(f"  #{c.index} icount={c.icount} rip={c.rip:#x} "
+              f"rsp={c.gpr[4]:#x} rax={c.gpr[0]:#x} "
+              f"mem[{len(c.mem)}]@{c.mem_gva:#x}={c.mem[:16].hex()}")
+    if opts.out:
+        opts.out.write_text(json.dumps(
+            [c.as_dict() if c else None for c in captures], indent=1))
+        print(f"wrote {opts.out}")
+    return 0
 
 
 def cmd_lint(args) -> int:
@@ -605,6 +864,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "master": cmd_master,
         "campaign": cmd_campaign,
         "snapshot": cmd_snapshot,
+        "triage": cmd_triage,
         "lint": cmd_lint,
     }[args.subcommand]
     return driver(args)

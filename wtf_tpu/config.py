@@ -91,6 +91,31 @@ class MasterOptions:
 
 
 @dataclasses.dataclass
+class TriageOptions:
+    """`wtf-tpu triage {minimize,distill,vbreak}` (wtf_tpu/triage — the
+    batched triage engine; no reference equivalent, the reference
+    triages host-serially through `run`)."""
+
+    name: str = ""
+    cmd: str = "minimize"        # minimize | distill | vbreak
+    backend: str = "tpu"
+    input: Optional[Path] = None     # minimize/vbreak testcase (or dir)
+    output: Optional[Path] = None    # minimize: minimized reproducer
+    limit: int = 0
+    lanes: int = 64
+    mesh_devices: Optional[int] = None
+    max_rounds: int = 64             # minimize: structural round cap
+    from_checkpoint: Optional[Path] = None  # distill: campaign ckpt dir
+    break_at: str = ""               # vbreak: symbol | hex | sym+0xOFF
+    hit: int = 1                     # vbreak: capture on Nth arrival
+    min_icount: int = 0              # vbreak: icount floor for capture
+    mem: str = ""                    # vbreak: GVA:LEN window (hex ok)
+    variants: int = 0                # vbreak: perturbed replicas/input
+    out: Optional[Path] = None       # vbreak: JSON capture dump
+    paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
+
+
+@dataclasses.dataclass
 class CampaignOptions:
     """`wtf campaign` (single-process master+node fused loop — the batch
     framework's native mode; no reference equivalent)."""

@@ -170,6 +170,13 @@ class FuzzLoop:
         self.stats = CampaignStats(self.registry)
         self.stats_every = stats_every
         self.crash_names = set()
+        # triage-grade crash dedup (wtf_tpu/triage/bucket.py): found
+        # crashes bucket by (kind, faulting RIP, top-of-stack hash), not
+        # by output filename — two bugs faulting on the same wild
+        # address stay distinct, and the minimizer's "same crash"
+        # test agrees with the harvest's.  crash_names keeps tracking
+        # the reference-shaped filenames the saves land under.
+        self.crash_buckets = set()
         # overlay-exhausted testcases get ONE honest re-run (they executed
         # on truncated memory); a second exhaustion drops them — the input
         # genuinely needs more dirty pages than the lane has slots
@@ -191,7 +198,7 @@ class FuzzLoop:
                 "(--backend=tpu); this backend has no coverage_state seam")
 
     def _account(self, data: bytes, result: TestcaseResult,
-                 requeue: bool = False) -> int:
+                 requeue: bool = False, lane: Optional[int] = None) -> int:
         """Per-result accounting shared by fuzz and minset (they used to
         carry copy-pasted blocks of this): counters via CampaignStats,
         crash saving + events, optional overlay-full requeue.  Returns 1
@@ -203,7 +210,9 @@ class FuzzLoop:
                     self._requeue_digests.add(digest)
                     self._requeue.append(data)
             return 0
-        self._save_crash(data, result)
+        from wtf_tpu.triage.bucket import bucket_of
+
+        self._save_crash(data, result, bucket_of(self.backend, lane, result))
         return 1
 
     def _harvest_lane(self, lane: int, data: bytes, result: TestcaseResult,
@@ -212,7 +221,7 @@ class FuzzLoop:
         batch paths: result accounting (+ optional overlay-full requeue)
         and the new-coverage -> corpus/mutator/event chain.  Returns 1
         for a crash."""
-        crashes = self._account(data, result, requeue=requeue)
+        crashes = self._account(data, result, requeue=requeue, lane=lane)
         if self.backend.lane_found_new_coverage(lane):
             self.stats.new_coverage += 1
             if self.corpus.add(data):
@@ -296,9 +305,12 @@ class FuzzLoop:
         self._restore_batch()
         return crashes
 
-    def _save_crash(self, data: bytes, result: Crash) -> None:
+    def _save_crash(self, data: bytes, result: Crash,
+                    bucket: Optional[str] = None) -> None:
         name = result.name or f"crash-{hex_digest(data)[:16]}"
-        new = name not in self.crash_names
+        bucket = bucket or name
+        new = bucket not in self.crash_buckets
+        self.crash_buckets.add(bucket)
         self.crash_names.add(name)
         if self.crashes_dir:
             from wtf_tpu.utils.atomicio import atomic_write_bytes
@@ -316,7 +328,8 @@ class FuzzLoop:
                     "crash save failed for %r: %s", name, e)
                 self.events.emit("error", kind="crash-save", name=name,
                                  detail=str(e))
-        self.events.emit("crash", name=name, size=len(data), new=new)
+        self.events.emit("crash", name=name, size=len(data), new=new,
+                         bucket=bucket)
 
     def _heartbeat(self, print_stats: bool) -> None:
         """stats_every cadence: the stable human line + one JSONL
@@ -333,24 +346,30 @@ class FuzzLoop:
         (the reference master's minset, server.h:552-556; seeds are
         visited biggest-first per Corpus.load_dir, so the subset is
         coverage-minimal under that ordering).  Returns the kept Corpus
-        (callers prune subsumed stale files with its digest set)."""
+        (callers prune subsumed stale files with its digest set).
+
+        Runs on the triage batch-replay core (wtf_tpu/triage/replay.py)
+        — minset and `triage distill` share ONE batched execution path;
+        the accounting, span names, keep rule (first-hit credit via the
+        backend's batch merge) and printed stats are unchanged."""
+        from wtf_tpu.triage.replay import ReplayCore
+
         # Corpus handles digest-named persistence + dedup; outputs_dir=None
         # (no outputs configured) counts without writing
-        spans = self.registry.spans
         kept = Corpus(outputs_dir=outputs_dir)
-        seeds = list(self.corpus)
-        for start in range(0, len(seeds), self.batch_size):
-            batch = seeds[start:start + self.batch_size]
-            with spans.span("execute"):
-                results = self.backend.run_batch(batch, self.target)
-            with spans.span("harvest"):
-                for lane, (data, result) in enumerate(zip(batch, results)):
-                    self._account(data, result)
-                    if self.backend.lane_found_new_coverage(lane):
-                        self.stats.new_coverage += 1
-                        kept.add(data)
-            self._restore_batch()
-            self._heartbeat(print_stats)
+        core = ReplayCore(self.backend, self.target,
+                          registry=self.registry, events=self.events,
+                          batch_size=self.batch_size)
+
+        def harvest(start, batch, results):
+            for lane, (data, result) in enumerate(zip(batch, results)):
+                self._account(data, result, lane=lane)
+                if self.backend.lane_found_new_coverage(lane):
+                    self.stats.new_coverage += 1
+                    kept.add(data)
+
+        core.replay(list(self.corpus), on_batch=harvest,
+                    after_batch=lambda: self._heartbeat(print_stats))
         return kept
 
     def fuzz(self, runs: int, print_stats: bool = False,

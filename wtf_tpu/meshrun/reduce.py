@@ -85,6 +85,15 @@ def bitplane_or(words, axis_name: str):
     return jnp.sum(merged << shifts, axis=-1, dtype=jnp.uint32)
 
 
+def _prefix_scan(x_in, prev):
+    """(inclusive cumulative OR, exclusive-prefix OR seeded with `prev`)
+    over the lane axis — the scan both the batch merge (`_merge_core`)
+    and the triage attribution (`first_hit_credit`) are built on."""
+    cum = lax.associative_scan(jnp.bitwise_or, x_in, axis=0)
+    before = jnp.concatenate([prev[None], prev | cum[:-1]], axis=0)
+    return cum, before
+
+
 def _merge_core(agg_cov, agg_edge, cov_in, edge_in, prev_cov, prev_edge):
     """Prefix-credit merge of one contiguous lane block, given the OR of
     every EARLIER lane (`prev_*` — zeros for lane block 0 / the
@@ -97,12 +106,8 @@ def _merge_core(agg_cov, agg_edge, cov_in, edge_in, prev_cov, prev_edge):
     polluting it with coverage-duplicate testcases and measurably
     diluting guided search.  Returns (block cov union, block edge union,
     new_lane flags for the block)."""
-    cum_cov = lax.associative_scan(jnp.bitwise_or, cov_in, axis=0)
-    cum_edge = lax.associative_scan(jnp.bitwise_or, edge_in, axis=0)
-    before_cov = jnp.concatenate(
-        [prev_cov[None], prev_cov | cum_cov[:-1]], axis=0)
-    before_edge = jnp.concatenate(
-        [prev_edge[None], prev_edge | cum_edge[:-1]], axis=0)
+    cum_cov, before_cov = _prefix_scan(cov_in, prev_cov)
+    cum_edge, before_edge = _prefix_scan(edge_in, prev_edge)
     new_lane = (
         jnp.any((cov_in & ~agg_cov[None] & ~before_cov) != 0, axis=1)
         | jnp.any((edge_in & ~agg_edge[None] & ~before_edge) != 0, axis=1))
@@ -124,6 +129,35 @@ def merge_coverage(agg_cov, agg_edge, cov, edge, include):
     new_cov_words = cov_union & ~agg_cov
     return (agg_cov | cov_union, agg_edge | edge_union,
             new_lane & include, new_cov_words)
+
+
+@jax.jit
+def first_hit_credit(agg_cov, agg_edge, cov, edge, include):
+    """Exact per-lane coverage attribution under replay order — the
+    device half of wtf_tpu/triage's corpus distillation.
+
+    Runs the SAME exclusive-prefix scan as `_merge_core` but keeps the
+    whole per-lane credit PLANES instead of collapsing them to flags:
+    lane i is credited exactly the cov/edge bits it is FIRST to set —
+    not in `agg_*` (earlier batches) and not contributed by any earlier
+    lane of this batch.  Excluded lanes (`include` false: timeouts,
+    overlay-full) contribute and receive nothing, matching the batch
+    merge's revocation rule.
+
+    Returns (credit_cov [L, Wc], credit_edge [L, We], agg_cov', agg_edge')
+    — summing each lane's credit popcount over a whole corpus sweep gives
+    the exact-attribution ledger, and OR-ing the credits reproduces the
+    aggregate delta (the host-recount differential tests/test_triage.py
+    pins)."""
+    inc = include[:, None]
+    cov_in = jnp.where(inc, cov, 0)
+    edge_in = jnp.where(inc, edge, 0)
+    cum_cov, before_cov = _prefix_scan(cov_in, agg_cov)
+    cum_edge, before_edge = _prefix_scan(edge_in, agg_edge)
+    credit_cov = cov_in & ~before_cov
+    credit_edge = edge_in & ~before_edge
+    return (credit_cov, credit_edge,
+            agg_cov | cum_cov[-1], agg_edge | cum_edge[-1])
 
 
 _MESH_MERGE_CACHE: dict = {}

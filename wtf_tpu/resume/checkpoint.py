@@ -133,6 +133,9 @@ def _campaign_state(loop) -> dict:
         "batches": loop.batches_done,
         "stats": loop.registry.counters_state(COUNTER_PREFIXES),
         "crash_names": sorted(loop.crash_names),
+        # triage-grade dedup keys (wtf_tpu/triage/bucket.py): without
+        # them a resumed campaign would re-announce known buckets as new
+        "crash_buckets": sorted(loop.crash_buckets),
         "requeue": [data.hex() for data in loop._requeue],
         "requeue_digests": sorted(loop._requeue_digests),
         "rng": {
@@ -277,6 +280,7 @@ def restore_campaign(loop, state, directory) -> int:
     if mut_state != "shared":
         _set_rng_state(getattr(loop.mutator, "rng", None), mut_state)
     loop.crash_names = set(state.get("crash_names", []))
+    loop.crash_buckets = set(state.get("crash_buckets", []))
     loop._requeue = [bytes.fromhex(h) for h in state.get("requeue", [])]
     loop._requeue_digests = set(state.get("requeue_digests", []))
     runner = getattr(loop.backend, "runner", None)

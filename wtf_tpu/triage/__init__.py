@@ -1,0 +1,36 @@
+"""Batched triage: crash minimization, corpus distillation, and
+virtual-breakpoint replay as device workloads.
+
+ROADMAP item 5: anything shaped "run thousands of variants of one
+testcase" is a mesh dispatch, so triage throughput scales on the same
+hardware as fuzzing throughput.  Three workloads share one batch-replay
+core (replay.py) that drives the campaign's own dispatch seams — the
+Runner/MeshRunner chunk executors, the devmut slab-upload format for
+candidate batches, and the `[words, 32]` coverage bit-planes:
+
+  bucket.py      the triage-grade crash key (kind, faulting RIP,
+                 top-of-stack hash) — ONE dedup helper shared by the
+                 fuzz-loop harvest and the minimizer
+  candidates.py  in-graph candidate builds (truncate / block-delete /
+                 zero) in the devmut byte-plane idiom; PORTED_LIMB_PATHS
+                 puts them under the lint dtype pin
+  replay.py      ReplayCore — chunked host-bytes sweeps and device-built
+                 batches, per-testcase planes, exact first-hit credit;
+                 FuzzLoop.minset runs on it
+  minimize.py    bisecting batch minimizer (`triage minimize`)
+  distill.py     exact-attribution corpus distillation + greedy set
+                 cover (`triage distill`)
+  vbreak.py      batched register+memory snapshots at an armed RIP
+                 (`triage vbreak`)
+
+All three land as `wtf-tpu triage {minimize,distill,vbreak}` and are
+bit-identical under `--mesh-devices N` vs single device at equal seeds.
+"""
+
+from wtf_tpu.triage.bucket import bucket_of, crash_kind, make_bucket  # noqa: F401
+from wtf_tpu.triage.distill import DistillResult, distill, greedy_cover  # noqa: F401
+from wtf_tpu.triage.minimize import MinimizeResult, minimize  # noqa: F401
+from wtf_tpu.triage.replay import ReplayCore, ReplaySweep  # noqa: F401
+from wtf_tpu.triage.vbreak import (  # noqa: F401
+    BreakCapture, oracle_capture, perturbations, vbreak,
+)

@@ -1,0 +1,138 @@
+"""In-graph candidate generation for crash minimization.
+
+One `build` dispatch turns the current best reproducer into a whole
+batch of candidate reductions — the devmut-engine technique (byte plane
++ clamped gathers, u32 only) applied to the minimizer's three candidate
+classes:
+
+  OP_TRUNCATE  keep the first `pos` bytes (tail removal)
+  OP_DELETE    remove the block [pos, pos+size) — the tail shifts left
+               through ONE clamped gather (engine.take's trick)
+  OP_ZERO      overwrite [pos, pos+size) with 0x00 at unchanged length
+               (byte simplification; size 0 == identity, the baseline
+               replay descriptor)
+
+The reproducer is uploaded once per round as packed u32 words
+(zero-padded past its length, the devmut slab contract); descriptors
+(op/pos/size per lane) are tiny host arrays.  Output feeds straight
+into `Runner.device_insert` via `TpuBackend.run_batch_words`, so the
+candidate bytes never visit the host — the harvest pulls only the one
+winning lane.
+
+Every path here is exported through `PORTED_LIMB_PATHS` so `wtf-tpu
+lint`'s dtype family compiles it under the zero-u64/f64 pin, exactly
+like the step's and devmut's ported paths.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from wtf_tpu.devmut.engine import unpack_bytes, pack_words
+
+OP_TRUNCATE = 0
+OP_DELETE = 1
+OP_ZERO = 2
+OP_NAMES = ("truncate", "delete", "zero")
+
+
+def build_candidates(cur_words, cur_len, ops, pos, size
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Build one candidate per lane from the current reproducer.
+
+    cur_words uint32[W]   packed reproducer (zero-padded past cur_len)
+    cur_len   uint32[]    reproducer byte length (>= 1)
+    ops       int32[L]    OP_* per lane
+    pos       uint32[L]   truncate: the new length; delete/zero: offset
+    size      uint32[L]   delete/zero block size (clamped in-graph)
+
+    Returns (words uint32[L, W], lens int32[L]).  Candidate lengths stay
+    >= 1; bytes past each candidate's length are zero (the padded-slab
+    contract device_insert relies on for deterministic page contents).
+    """
+    n_words = cur_words.shape[0]
+    max_len = n_words * 4
+    n_lanes = ops.shape[0]
+    ml = jnp.uint32(max_len)
+    one = jnp.uint32(1)
+    idx = lax.broadcasted_iota(jnp.uint32, (n_lanes, max_len), 1)
+    lane = lax.broadcasted_iota(jnp.int32, (n_lanes, max_len), 0)
+    b = jnp.broadcast_to(unpack_bytes(cur_words)[None, :],
+                         (n_lanes, max_len))
+
+    def take(bb, src_u32):
+        src = jnp.minimum(src_u32, ml - one).astype(jnp.int32)
+        return bb[lane, src]
+
+    # truncate: new length = clamp(pos, 1, cur_len)
+    ln_tr = jnp.clip(pos, one, cur_len)
+
+    # delete [pos, pos+size): clamp so at least one byte survives
+    dpos = jnp.minimum(pos, cur_len - one)
+    dsz = jnp.minimum(jnp.minimum(size, cur_len - dpos),
+                      cur_len - one)
+    src_del = jnp.where(idx < dpos[:, None], idx, idx + dsz[:, None])
+    b_del = take(b, src_del)
+    ln_del = cur_len - dsz
+
+    # zero [pos, pos+size) at unchanged length (size 0 == identity)
+    zwin = (idx >= pos[:, None]) & (idx < (pos + size)[:, None])
+    b_zero = jnp.where(zwin, jnp.uint32(0), b)
+
+    is_tr = (ops == jnp.int32(OP_TRUNCATE))[:, None]
+    is_del = (ops == jnp.int32(OP_DELETE))[:, None]
+    out_b = jnp.where(is_del, b_del, jnp.where(is_tr, b, b_zero))
+    out_ln = jnp.where(is_del[:, 0], ln_del,
+                       jnp.where(is_tr[:, 0], ln_tr,
+                                 jnp.broadcast_to(cur_len, (n_lanes,))))
+    out_b = jnp.where(idx < out_ln[:, None], out_b, jnp.uint32(0))
+    return pack_words(out_b), out_ln.astype(jnp.int32)
+
+
+def zero_counts(words, lens):
+    """Per-lane count of zero bytes inside each candidate's length —
+    the simplification half of the minimizer's (len, -zeros) score,
+    computed device-side so scoring never pulls candidate bytes."""
+    n_words = words.shape[1]
+    b = unpack_bytes(words)
+    idx = lax.broadcasted_iota(jnp.uint32, (words.shape[0], n_words * 4), 1)
+    inside = idx < lens.astype(jnp.uint32)[:, None]
+    return jnp.sum((inside & (b == jnp.uint32(0))).astype(jnp.uint32),
+                   axis=1, dtype=jnp.uint32).astype(jnp.int32)
+
+
+@lru_cache(maxsize=None)
+def make_build():
+    """The jitted candidate builder (shape specialization is jit's own:
+    one executor per (words, lanes))."""
+    return jax.jit(build_candidates)
+
+
+@lru_cache(maxsize=None)
+def make_zero_counts():
+    return jax.jit(zero_counts)
+
+
+def pack_testcase(data: bytes, max_len: int) -> Tuple[np.ndarray, int]:
+    """Host helper: bytes -> (packed u32[max_len/4] zero-padded, length).
+    The upload format `build_candidates` and the devmut slab share."""
+    data = data[:max_len]
+    words = (max_len + 3) // 4
+    buf = np.zeros(words * 4, dtype=np.uint8)
+    buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+    return buf.view(np.uint32), len(data)
+
+
+# Export hook for the static analyzer (mirrors step./devmut.
+# PORTED_LIMB_PATHS): compiled standalone under the zero-u64/f64 dtype
+# rule by `wtf-tpu lint`; argument recipes in analysis/rules.
+PORTED_LIMB_PATHS = {
+    "triage.build_candidates": build_candidates,
+    "triage.zero_counts": zero_counts,
+}
