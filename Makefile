@@ -7,9 +7,9 @@
 PY ?= python
 
 .PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke \
-	triage-smoke
+	triage-smoke tenancy-smoke
 
-verify: test lint chaos-smoke triage-smoke
+verify: test lint chaos-smoke triage-smoke tenancy-smoke
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -46,6 +46,14 @@ mesh-smoke:
 # the distilled minset must be a corpus subset with full coverage
 triage-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.triage_smoke
+
+# multi-tenant smoke (wtf_tpu/testing/tenancy_smoke): a mixed
+# demo_tlv+demo_kernel batch must be bit-identical per tenant to the
+# same campaigns run alone, and the `wtf-tpu sched` preemption drill
+# (checkpoint tenant A, backfill with B, resume A) must end
+# bit-identical to an uninterrupted run
+tenancy-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.tenancy_smoke
 
 # deterministic fault-tolerance soak (wtf_tpu/testing/chaos_smoke):
 # seeded fault schedule over the real socket + checkpoint seams —

@@ -209,6 +209,89 @@ def measure_lanes_ramp(seconds=None, limit=20_000):
     return cells
 
 
+def measure_tenants_ramp(seconds=None, limit=50_000, lanes_per_tenant=None):
+    """The tenant-mix ramp (wtf_tpu/tenancy): MultiTenantLoop campaigns
+    at 1..N co-resident tenants on one batch, equal wall per cell —
+    execs/s, per-tenant coverage, and the per-tenant-merge overhead
+    (the mixed batch runs T prefix-credit merges against the solo
+    batch's one).  Emits a JSON table like `ablate.py lanes`.  demo_pe
+    joins the 3-tenant cell only where its census DLL is present."""
+    import jax
+
+    from wtf_tpu.harness import demo_pe
+    from wtf_tpu.harness.targets import Targets, load_builtin_targets
+    from wtf_tpu.tenancy.backend import TenantSpec, create_tenancy_backend
+    from wtf_tpu.tenancy.loop import MultiTenantLoop, TenantRuntime
+
+    on_tpu = jax.default_backend() == "tpu"
+    if seconds is None:
+        seconds = 10.0 if on_tpu else 4.0
+    if lanes_per_tenant is None:
+        lanes_per_tenant = 256 if on_tpu else 16
+    load_builtin_targets()
+    targets = Targets.instance()
+    rows = [("t0", "demo_tlv", "tlv", b"\x01\x04AAAA\x02\x08BBBBBBBB"),
+            ("t1", "demo_kernel", "mangle", b"hello-world-123")]
+    if demo_pe.available():
+        rows.append(("t2", "demo_pe", "auto", demo_pe.BENIGN
+                     if hasattr(demo_pe, "BENIGN") else b"\x00" * 16))
+    cells = []
+    for n_tenants in range(1, len(rows) + 1):
+        cfg = rows[:n_tenants]
+        specs = [TenantSpec(n, targets.get(t), targets.get(t).snapshot(),
+                            lanes_per_tenant)
+                 for n, t, _m, _s in cfg]
+        backend = create_tenancy_backend(
+            specs, lanes_per_tenant * n_tenants, limit=limit,
+            chunk_steps=128, overlay_slots=16)
+        backend.initialize()
+        for i, s in enumerate(specs):
+            with backend.tenant_context(i):
+                s.target.init(backend)
+        runtimes, lane_lo = [], 0
+        for i, (n, _t, m, seed_data) in enumerate(cfg):
+            rt = TenantRuntime(specs[i], seed=0x7E0 + i, runs=1 << 30,
+                               mutator_name=m, max_len=256,
+                               lane_lo=lane_lo)
+            rt.corpus.add(seed_data)
+            runtimes.append(rt)
+            lane_lo += lanes_per_tenant
+        loop = MultiTenantLoop(backend, runtimes, stats_every=1e9)
+        loop.run_one_batch()   # warmup: compiles + decode servicing
+        c0 = loop.stats.testcases
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            loop.run_one_batch()
+        dt = time.time() - t0
+        cell = {
+            "tenants": n_tenants,
+            "lanes": lanes_per_tenant * n_tenants,
+            "execs_per_s": round((loop.stats.testcases - c0) / dt, 2),
+            "per_tenant": {
+                rt.name: {
+                    "execs": int(rt.stats["testcases"]),
+                    "cov_bits": int(np.unpackbits(np.asarray(
+                        backend.tenant_coverage_state(i)[0]).view(
+                            "uint8")).sum()),
+                    "new_coverage": int(rt.stats["new_coverage"]),
+                }
+                for i, rt in enumerate(runtimes)
+            },
+            # the per-tenant merge cost: T prefix-credit merges per
+            # batch in the mixed cell vs the solo cell's one
+            "cov_readback_s": round(
+                loop.registry.spans.seconds("execute/cov-readback"), 4),
+        }
+        cells.append(cell)
+        print(json.dumps({"config": "tenants-ramp", **cell}), flush=True)
+    print(json.dumps({
+        "config": "tenants-ramp-summary", "limit": limit,
+        "seconds_per_cell": seconds,
+        "platform": jax.devices()[0].platform, "cells": cells,
+    }), flush=True)
+    return cells
+
+
 def measure_deep(n_lanes=1024, limit=10_000_000, seconds=30.0):
     """BASELINE-config-3-shaped end-to-end number (the same workload
     bench.py reports in its `deep` extras): mangle campaign on demo_spin
@@ -255,7 +338,7 @@ if __name__ == "__main__":
     faulthandler.dump_traceback_later(
         int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
     names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused", "devmut",
-                                             "lanes"]
+                                             "lanes", "tenants"]
     for n in names:
         if n == "deep":
             measure_deep()
@@ -265,6 +348,8 @@ if __name__ == "__main__":
             measure_devmut()
         elif n == "lanes":
             measure_lanes_ramp()
+        elif n == "tenants":
+            measure_tenants_ramp()
         else:
             measure(n, CONFIGS[n])
         faulthandler.cancel_dump_traceback_later()

@@ -107,7 +107,7 @@ def test_dmp_snapshot_fuzzes(tmp_path):
     state = tmp_path / "state"
     state.mkdir()
     # export guest memory as a BMP crash dump
-    table = np.asarray(snap.physmem.image.frame_table)
+    table = np.asarray(snap.physmem.image.frame_table)[0]
     page_data = np.asarray(snap.physmem.image.pages)
     pages = {int(pfn): bytes(page_data[int(table[pfn])].tobytes())
              for pfn in np.nonzero(table)[0]}

@@ -303,7 +303,7 @@ def test_translate_vec_matches_host_walk(paged_guest):
             for i in range(size):
                 a = gpa + i
                 page = np.asarray(mem.image.pages[
-                    int(mem.image.frame_table[a >> 12])]).tobytes()
+                    int(mem.image.frame_table[0, a >> 12])]).tobytes()
                 out.append(page[a & 0xFFF])
             return bytes(out)
 

@@ -15,9 +15,8 @@ import pytest
 from wtf_tpu.harness import demo_tlv
 from wtf_tpu.interp.runner import Runner, warm_decode_cache
 from wtf_tpu.interp.step import make_run_chunk
-from wtf_tpu.parallel.mesh import (
-    make_mesh, merged_coverage, replicate, shard_machine,
-)
+from wtf_tpu.meshrun.mesh import make_mesh, replicate, shard_machine
+from wtf_tpu.meshrun.reduce import merged_coverage
 
 PAYLOAD = b"\x01\x02AB\x03\x08CCCCCCCC"
 N_DEVICES = 8

@@ -182,6 +182,49 @@ def summarize(path) -> dict:
             triage["candidates_per_s"] = round(
                 tri_signals["candidates"] / wall, 2)
 
+    # multi-tenant campaigns (wtf_tpu/tenancy): one block per tenant off
+    # the `tenant.<name>.*` namespaces — execs/s, new-coverage, crash
+    # buckets (from tenant-tagged crash events), lane-seconds — plus the
+    # scheduler's round/preemption census.  None when the run had no
+    # tenants.
+    tenants = None
+    tenant_names = sorted({name.split(".")[1] for name in metrics
+                           if name.startswith("tenant.")
+                           and len(name.split(".")) >= 3})
+    if tenant_names:
+        buckets_by_tenant: dict = {}
+        for rec in records:
+            if rec["type"] == "crash" and rec.get("tenant"):
+                b = buckets_by_tenant.setdefault(rec["tenant"], {})
+                key = rec.get("bucket") or rec.get("name") or "?"
+                b[key] = b.get(key, 0) + 1
+        per_tenant = {}
+        for name in tenant_names:
+            def tm(field, default=0):
+                return metrics.get(f"tenant.{name}.{field}", default) or 0
+
+            execs = tm("testcases")
+            per_tenant[name] = {
+                "testcases": execs,
+                "testcases_per_s": (round(execs / wall, 2)
+                                    if wall else None),
+                "crashes": tm("crashes"),
+                "crash_buckets": dict(sorted(
+                    buckets_by_tenant.get(name, {}).items())),
+                "new_coverage": tm("new_coverage"),
+                "timeouts": tm("timeouts"),
+                "batches": tm("batches"),
+                "lane_seconds": round(tm("lane_ms") / 1000.0, 3),
+                "checkpoints": tm("checkpoints"),
+                "resumes": tm("resumes"),
+            }
+        tenants = {"by_tenant": per_tenant}
+        sched = {key: metrics.get(f"sched.{key}", 0) or 0
+                 for key in ("rounds", "placements", "preemptions",
+                             "completions")}
+        if any(sched.values()):
+            tenants["sched"] = sched
+
     # resilience (fault-tolerance tier): reconnect/reclaim/resume
     # activity + checkpoint cadence and cost.  None when the run had no
     # fault-tolerance signal at all — quiet campaigns stay quiet.
@@ -262,6 +305,7 @@ def summarize(path) -> dict:
         },
         "mesh": mesh,
         "triage": triage,
+        "tenants": tenants,
         "resilience": resilience,
         "errors": errors,
     }
@@ -353,6 +397,26 @@ def _print_human(s: dict) -> None:
                   f"{tri['minset_after']} seeds")
         if tri["captures"]:
             print(f"  vbreak: {tri['captures']} captures")
+    ten = s.get("tenants")
+    if ten:
+        sched = ten.get("sched")
+        line = "tenants:"
+        if sched:
+            line += (f" (sched: {sched['rounds']} rounds, "
+                     f"{sched['placements']} placements, "
+                     f"{sched['preemptions']} preemptions, "
+                     f"{sched['completions']} completions)")
+        print(line)
+        for name, d in ten["by_tenant"].items():
+            rate = (f" ({d['testcases_per_s']}/s)"
+                    if d["testcases_per_s"] else "")
+            print(f"  {name:<12} execs={d['testcases']}{rate} "
+                  f"newcov={d['new_coverage']} "
+                  f"crashes={d['crashes']} "
+                  f"buckets={len(d['crash_buckets'])} "
+                  f"batches={d['batches']} "
+                  f"lane-s={d['lane_seconds']} "
+                  f"ckpt={d['checkpoints']}/{d['resumes']}")
     res = s.get("resilience")
     if res:
         ckpt = (f", checkpoints={res['checkpoints']} "

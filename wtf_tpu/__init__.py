@@ -18,12 +18,13 @@ Layering (mirrors SURVEY.md section 1's layer map, redesigned TPU-first):
   harness/  - target registry, crash detection, guest-fs, demos      (L4)
   fuzz/     - corpus, mutators (python + native), dirwatch, loop     (L5)
   dist/     - master/node wire protocol + reactor                    (L5)
-  parallel/ - device mesh sharding, multi-chip coverage reduction    (L5)
+  meshrun/  - device mesh sharding, shard_map executors, mesh merge  (L5)
   resume/   - crash-safe campaign checkpoint/resume                  (L5)
+  tenancy/  - multi-tenant batch + campaign scheduler                (L5)
   testing/  - deterministic chaos harness (fault injection)          (aux)
   trace/    - rip/cov/tenet trace writers                            (aux)
   native/   - on-demand-built C++ components (kdmp, mangle)          (aux)
-  cli.py    - `master|fuzz|run|campaign` subcommands                 (L6)
+  cli.py    - `master|fuzz|run|campaign|sched` subcommands           (L6)
   config.py - per-subcommand options objects + path conventions      (L6)
 """
 

@@ -16,7 +16,11 @@ the result:
             against analysis/budgets.json (the PERF.md round-8 "168
             surviving kernels" math as a regression gate); plus the
             triage-chunk identity pin — wtf_tpu/triage's replay core
-            must dispatch this same ladder (zero new kernels)
+            must dispatch this same ladder (zero new kernels) — and the
+            tenancy pins (wtf_tpu/tenancy): the heterogeneous chunk's
+            kernel census against the `tenant_chunk` budget entry, and
+            program byte-stability across tenant permutations ("one
+            compiled program per lane count regardless of tenant mix")
   recompile re-trace the executor under perturbed-but-same-shape inputs
             and flag signature instability; weak-typed executor operands
             (a python scalar passed where a committed dtype belongs —
@@ -51,7 +55,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from wtf_tpu.analysis.findings import Finding
 from wtf_tpu.analysis.parity import check_fused_parity
 from wtf_tpu.analysis.trace import (
-    build_tlv_runner, compiled_hlo, step_executor_lowering,
+    build_tenant_runner, build_tlv_runner, compiled_hlo,
+    step_executor_lowering, tenant_executor_lowering,
 )
 
 BUDGETS_PATH = Path(__file__).with_name("budgets.json")
@@ -66,6 +71,17 @@ DATA_DEP_OPS = ("gather", "dynamic-slice", "dynamic-update-slice", "scatter")
 # unroll), but the pin is only meaningful against one fixed entry shape
 BUDGET_ENTRY = "xla_step"
 BUDGET_CONFIG = dict(n_lanes=4, chunk_steps=64, n_steps=64, donate=True)
+
+# canonical heterogeneous-batch configuration (wtf_tpu/tenancy): the
+# budget family lowers the SAME step ladder over a two-tenant stacked
+# image table, counts its gather-class kernels against the
+# `tenant_chunk` budget entry, and pins the compiled program
+# byte-identical under a permuted tenant table — "one program per lane
+# count regardless of tenant mix"
+TENANT_ENTRY = "tenant_chunk"
+TENANT_CONFIG = dict(n_steps=16, quotas=(2, 2),
+                     order=("demo_tlv", "demo_kernel"),
+                     uop_capacity=1 << 10, overlay_slots=8, edge_bits=12)
 
 # the cross-device collective HLO ops the mesh family censuses: on the
 # lane mesh the compiled chunk may hold exactly ONE — the coverage
@@ -373,6 +389,67 @@ def check_triage_chunk() -> List[Finding]:
     return findings
 
 
+def _first_diff_line(text_a: str, text_b: str) -> Tuple[int, str]:
+    """(0-based line index, detail) of the first differing line between
+    two lowerings; (-1, "length mismatch") when one is a prefix of the
+    other.  Shared by the byte-stability rules."""
+    for i, (la, lb) in enumerate(zip(text_a.splitlines(),
+                                     text_b.splitlines())):
+        if la != lb:
+            return i, la.strip()[:80]
+    return -1, "length mismatch"
+
+
+def check_tenant_mix_stability(text_a: str, text_b: str,
+                               entry: str) -> List[Finding]:
+    """The heterogeneous batch's serving contract, statically: at a
+    given lane count the chunk executor must lower to the SAME program
+    bytes for any tenant mix — tenant identity is pure data (the
+    per-lane selector + stacked table contents), never a traced value.
+    The probe permutes the tenant TABLE (demo_tlv+demo_kernel vs
+    demo_kernel+demo_tlv: same shapes, different contents and lane
+    assignment); a diff means a tenant-mix-dependent value is baked into
+    the trace and every mix would compile its own program."""
+    if text_a == text_b:
+        return []
+    i, detail = _first_diff_line(text_a, text_b)
+    return [Finding(
+        rule="budget.tenant-mix", entry=entry,
+        primitive=f"line {i + 1}: {detail}",
+        message=("the compiled step ladder differs across tenant "
+                 "permutations at equal lane count — tenant identity "
+                 "leaked into the traced program; heterogeneous batches "
+                 "must share ONE compiled program per lane count"))]
+
+
+def run_tenant_rules(budgets_path: Optional[Path] = None,
+                     rebaseline: bool = False) -> Tuple[List[Finding],
+                                                        Dict]:
+    """The tenancy half of the budget family: image-table kernel census
+    + tenant-mix program stability.  Returns (findings, info) with the
+    measured counts for run_lint's rebaseline merge."""
+    cfg = TENANT_CONFIG
+    entry = (f"make_run_chunk({cfg['n_steps']}, donate=False) / "
+             f"{'+'.join(cfg['order'])} / quotas={list(cfg['quotas'])}")
+    kwargs = dict(chunk_steps=cfg["n_steps"],
+                  uop_capacity=cfg["uop_capacity"],
+                  overlay_slots=cfg["overlay_slots"],
+                  edge_bits=cfg["edge_bits"])
+    runner = build_tenant_runner(quotas=cfg["quotas"], order=cfg["order"],
+                                 **kwargs)
+    lowered = tenant_executor_lowering(runner, n_steps=cfg["n_steps"])
+    permuted = build_tenant_runner(quotas=cfg["quotas"],
+                                   order=cfg["order"][::-1], **kwargs)
+    lowered_p = tenant_executor_lowering(permuted, n_steps=cfg["n_steps"])
+    findings = check_tenant_mix_stability(
+        lowered.as_text(), lowered_p.as_text(), entry=entry)
+    counts = count_data_dependent_ops(lowered.compile().as_text())
+    if not rebaseline:
+        budget = load_budgets(budgets_path).get(TENANT_ENTRY, {})
+        findings.extend(check_budget(counts, budget, entry=entry))
+    return findings, {"tenant_counts": counts, "entry": entry}
+
+
 def load_budgets(path: Optional[Path] = None) -> Dict:
     path = Path(path) if path else BUDGETS_PATH
     return json.loads(path.read_text())
@@ -424,13 +501,7 @@ def check_signature_stable(text_a: str, text_b: str,
     every such value is a silent retrace per distinct value."""
     if text_a == text_b:
         return []
-    for i, (la, lb) in enumerate(zip(text_a.splitlines(),
-                                     text_b.splitlines())):
-        if la != lb:
-            detail = la.strip()[:80]
-            break
-    else:
-        detail, i = "length mismatch", -1
+    i, detail = _first_diff_line(text_a, text_b)
     return [Finding(
         rule="recompile.signature-unstable", entry=entry,
         primitive=f"line {i + 1}: {detail}",
@@ -746,6 +817,20 @@ def run_lint(families: Optional[Sequence[str]] = None,
         # the triage replay core rides the same compiled ladder: its
         # kernel contribution is ZERO by identity, checked statically
         findings.extend(check_triage_chunk())
+        # heterogeneous batches (wtf_tpu/tenancy): image-table kernel
+        # census + one-program-per-lane-count across tenant mixes
+        tenant_findings, tenant_info = run_tenant_rules(
+            budgets_path=budgets_path, rebaseline=rebaseline)
+        findings.extend(tenant_findings)
+        counts_t = tenant_info["tenant_counts"]
+        info["tenant_kernel_counts"] = counts_t
+        info["entries"].append(tenant_info["entry"])
+        if rebaseline:
+            measured_budgets[TENANT_ENTRY] = {
+                "entry": tenant_info["entry"], **counts_t}
+        for name, value in counts_t.items():
+            registry.gauge("analysis.tenant_kernel_count").labels(
+                name).set(value)
         info["seconds"]["budget"] = round(time.time() - t0, 1)
 
     if "recompile" in families:

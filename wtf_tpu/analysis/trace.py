@@ -83,6 +83,31 @@ def timed_chunk(runner, limit: int = 1 << 40) -> dict:
             "instr": int((np.asarray(m2.icount) - ic0).sum())}
 
 
+def build_tenant_runner(quotas=(2, 2), order=("demo_tlv", "demo_kernel"),
+                        chunk_steps: int = 16, **runner_kwargs):
+    """A heterogeneous two-tenant Runner (wtf_tpu/tenancy) in the
+    linter's shape-only configuration: demo_tlv + demo_kernel lanes
+    behind ONE stacked image table, no decode warmup, no payload.
+    `order` permutes the tenant table — the budget family lowers the
+    chunk under both orders and pins the programs byte-identical (tenant
+    identity is DATA; the compiled program depends only on shapes)."""
+    from wtf_tpu.harness.targets import Targets, load_builtin_targets
+    from wtf_tpu.interp.runner import Runner
+    from wtf_tpu.tenancy.backend import TenantSpec
+
+    load_builtin_targets()
+    targets = Targets.instance()
+    specs = []
+    for name, lanes in zip(order, quotas):
+        target = targets.get(name)
+        specs.append(TenantSpec(name=name, target=target,
+                                snapshot=target.snapshot(), lanes=lanes))
+    runner = Runner(specs[0].snapshot, n_lanes=sum(quotas),
+                    chunk_steps=chunk_steps, tenants=specs,
+                    **runner_kwargs)
+    return runner
+
+
 def build_tlv_campaign(n_lanes: int = 64, mutator: str = "mangle",
                        limit: int = 100_000, seed: int = 0x77F,
                        max_len: int = 0x400, registry=None,
@@ -164,3 +189,21 @@ def step_executor_lowering(runner, n_steps: int = 64, donate: bool = True,
     run_chunk = make_run_chunk(n_steps, donate=donate, jit=False)
     jitted = jax.jit(run_chunk, donate_argnums=(2,) if donate else ())
     return jitted.lower(tab, runner.physmem.image, machine, limit)
+
+
+def tenant_executor_lowering(runner, n_steps: int = 16,
+                             donate: bool = False):
+    """Lowered handle of the chunked step ladder on a heterogeneous
+    runner's operands — `runner.image` (the stacked table + per-lane
+    tenant selector), not `runner.physmem.image` (tenant 0's plain
+    image).  Fresh trace per call, same reasoning as
+    step_executor_lowering."""
+    import jax
+    import jax.numpy as jnp
+
+    from wtf_tpu.interp.step import make_run_chunk
+
+    run_chunk = make_run_chunk(n_steps, donate=donate, jit=False)
+    jitted = jax.jit(run_chunk, donate_argnums=(2,) if donate else ())
+    return jitted.lower(runner.cache.device(), runner.image,
+                        runner.machine, jnp.uint64(0))

@@ -250,9 +250,15 @@ class TpuBackend(Backend):
     def lane_result_detail(self, lane: int) -> str:
         return self.runner.lane_errors.get(lane, "")
 
+    def _bp_handler(self, lane: int, rip: int):
+        """Handler lookup for a lane stopped at `rip` — the seam the
+        multi-tenant backend re-keys by (tenant, rip) so two base images
+        sharing a virtual address dispatch to their own targets."""
+        return self.breakpoints.get(rip)
+
     def _dispatch_bp(self, runner: Runner, view: HostView, lane: int) -> None:
         rip = view.get_rip(lane)
-        handler = self.breakpoints.get(rip)
+        handler = self._bp_handler(lane, rip)
         if handler is None:
             runner.lane_errors[lane] = f"unexpected breakpoint @ {rip:#x}"
             view.set_status(lane, StatusCode.HARD_ERROR)
@@ -407,7 +413,8 @@ class TpuBackend(Backend):
         from wtf_tpu.cpu.interrupts import deliver_exception
         from wtf_tpu.interp.runner import _LaneCtx
 
-        ctx = _LaneCtx(self._ensure_view(), self._lane, self.snapshot.cpu)
+        ctx = _LaneCtx(self._ensure_view(), self._lane,
+                       self.runner.cpu0_of(self._lane))
         deliver_exception(ctx, vector, error_code, cr2)
 
     def virt_read(self, gva: int, size: int) -> bytes:

@@ -224,6 +224,42 @@ def build_parser() -> argparse.ArgumentParser:
     camp.add_argument("--process-id", type=int, default=None)
     _add_backend_tuning(camp, mesh=True)
 
+    sched = sub.add_parser(
+        "sched", help="multi-tenant campaign scheduler (wtf_tpu/tenancy):"
+                      " a jobs table placed onto ONE batched device "
+                      "program by priority and lane quota, preempted "
+                      "via per-tenant checkpoints")
+    sched.add_argument("--jobs", type=Path, required=True,
+                       help='jobs table: {"jobs": [{"name", "target", '
+                            '"lanes", "runs", "priority", "seed", '
+                            '"mutator", "max_len", "inputs", '
+                            '"checkpoint_every"}, ...]}')
+    sched.add_argument("--workdir", type=Path, required=True,
+                       help="per-job state root: <workdir>/<job>/"
+                            "{checkpoint,crashes}.  Checkpoints carry "
+                            "every bit a job needs across placements, "
+                            "so a killed sched run resumes from here")
+    sched.add_argument("--lanes", type=int, default=64,
+                       help="total lane budget of the shared batch")
+    sched.add_argument("--limit", type=int, default=0,
+                       help="instruction budget per testcase (applies "
+                            "to every job: the limit is one operand of "
+                            "the shared compiled program)")
+    sched.add_argument("--quantum", type=int, default=4,
+                       help="batches per scheduling round; at each "
+                            "quantum boundary unfinished placed jobs "
+                            "checkpoint, and jobs left waiting preempt "
+                            "them in the next placement")
+    sched.add_argument("--max-rounds", type=int, default=1 << 12)
+    sched.add_argument("--target-module", action="append", default=[],
+                       help="extra python module(s) to import for "
+                            "target registration")
+    sched.add_argument("--telemetry-dir", type=Path, default=None,
+                       help="events.jsonl with tenant-tagged records + "
+                            "sched-round/sched-preempt/sched-complete; "
+                            "summarize with tools/telemetry_report.py")
+    _add_backend_tuning(sched, mesh=True)
+
     triage = sub.add_parser(
         "triage", help="batched crash triage on the device batch "
                        "(wtf_tpu/triage): minimize / distill / vbreak")
@@ -623,6 +659,33 @@ def cmd_campaign(args) -> int:
         return 0 if stats.crashes == 0 else 2
 
 
+def cmd_sched(args) -> int:
+    from wtf_tpu.tenancy.sched import Scheduler, load_jobs
+
+    load_builtin_targets()
+    for module in args.target_module:
+        importlib.import_module(module)
+    jobs = load_jobs(args.jobs)
+    tuning = _backend_tuning_kwargs(args)
+    mesh_devices = tuning.pop("mesh_devices", None)
+    with _telemetry_for(args) as (registry, events):
+        sched = Scheduler(jobs, n_lanes=args.lanes, workdir=args.workdir,
+                          limit=args.limit, quantum=args.quantum,
+                          mesh_devices=mesh_devices,
+                          registry=registry, events=events,
+                          backend_tuning=tuning)
+        summary = sched.run(max_rounds=args.max_rounds)
+    crashes = 0
+    for name, s in summary.items():
+        crashes += s["crashes"]
+        state = ("done" if s["done"]
+                 else f"stopped at batch {s['batches']}")
+        print(f"[sched] {name}: {state}, {s['testcases']} testcases, "
+              f"{s['crashes']} crashes, {s['preemptions']} preemptions")
+    print(f"[sched] {sched.rounds} rounds over {args.lanes} lanes")
+    return 0 if crashes == 0 else 2
+
+
 def _parse_break_at(spec: str, symbols: dict) -> int:
     """hex address, symbol, or symbol+0xOFF over the snapshot's symbol
     store (the reference resolves bp sites the same way, backend.cc:
@@ -823,7 +886,7 @@ def cmd_snapshot(args) -> int:
     if args.format == "npz":
         snap.save_raw(args.out)
     else:
-        table = np.asarray(snap.physmem.image.frame_table)
+        table = np.asarray(snap.physmem.image.frame_table)[0]
         page_data = np.asarray(snap.physmem.image.pages).view(np.uint8)
         pages = {int(pfn): page_data[int(table[pfn])].tobytes()
                  for pfn in np.nonzero(table)[0]}
@@ -863,6 +926,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "fuzz": cmd_fuzz,
         "master": cmd_master,
         "campaign": cmd_campaign,
+        "sched": cmd_sched,
         "snapshot": cmd_snapshot,
         "triage": cmd_triage,
         "lint": cmd_lint,

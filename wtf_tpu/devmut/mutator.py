@@ -123,12 +123,19 @@ class DevMangleMutator(Mutator):
         # generator per shard (slab replicated, seed stream lane-sharded)
         # with the identical per-lane program, so the byte stream stays
         # bit-exact against hostref.lane_seeds on any mesh size
-        out = self.runner.devmut_generate(self.rounds, data, lens, cumw,
-                                          seeds)
+        out = self.generate(self.rounds, data, lens, cumw, seeds)
         self._batch += 1
         self.stats["batches"] += 1
         self.stats["generated"] += self.n_lanes
         return out
+
+    def generate(self, rounds: int, data, lens, cumw, seeds):
+        """The generation dispatch — overridable seam: the campaign path
+        routes through the runner (mesh runners shard the seed stream);
+        tenant-scoped engines (wtf_tpu/tenancy) dispatch the plain
+        engine over their lane quota, which is bit-exact by the same
+        per-lane program."""
+        return self.runner.devmut_generate(rounds, data, lens, cumw, seeds)
 
     def prelaunch(self) -> None:
         """Dispatch generation of the NEXT batch onto the device queue

@@ -64,7 +64,9 @@ from wtf_tpu.interp.uoptable import (
     F_OPC, F_OPSIZE, F_SCALE, F_SEG, F_SEXT, F_SRCSIZE, F_SRC_KIND,
     F_SRC_REG, F_SUB, M_BP, M_PFN0, M_PFN1, PROBES, UopTable,
 )
-from wtf_tpu.mem.physmem import MemImage, PAGE_WORDS
+from wtf_tpu.mem.physmem import (
+    IMAGE_IN_AXES, MemImage, PAGE_WORDS, lane_image,
+)
 
 _RUNNING = int(StatusCode.RUNNING)
 _NEED_DECODE = int(StatusCode.NEED_DECODE)
@@ -124,7 +126,7 @@ def _build_kernel(k_steps: int, n_fields: int, hash_size: int,
     hmask = hash_size - 1
 
     def kernel(hash_ref, trip_ref, tmeta_ref, tmu_ref, pages_ref, ftab_ref,
-               ovpfn_ref, limit_ref,
+               ovpfn_ref, limit_ref, tenant_ref,
                gpr_in, rip_in, rf_in, st_in, ic_in, bp_in, ctr_in, cov_in,
                edge_in,
                gpr_out, rip_out, rf_out, st_out, ic_out, bp_out, ctr_out,
@@ -137,29 +139,36 @@ def _build_kernel(k_steps: int, n_fields: int, hash_size: int,
         limit_on = (limit_ref[0] | limit_ref[1]) != _u32(0)
         z = _u32(0)
         zero2 = (z, z)
+        # the lane's base-image id (wtf_tpu/tenancy): selects the frame-
+        # table row and tags the decode-probe key, exactly like step_lane
+        tenant = tenant_ref[0]
+        ttag = tenant.astype(jnp.uint32) << 16      # bit 48 = hi limb bit 16
 
         def probe(rip_l):
             """uop_lookup's open-addressed probe, one slot at a time (the
             scalar gather emulation of the XLA path's 8-slot gather pair;
-            first live match wins, same result by insertion uniqueness)."""
-            h_lo, _ = L.splitmix64(rip_l)
+            first live match wins, same result by insertion uniqueness).
+            Probes the tenant-tagged key, like step_lane."""
+            key_l = (rip_l[0], rip_l[1] ^ ttag)
+            h_lo, _ = L.splitmix64(key_l)
 
             def body(k, found):
                 slot = ((h_lo + _u32(0) + k.astype(jnp.uint32))
                         & _u32(hmask)).astype(jnp.int32)
                 e = hash_ref[slot]
                 ec = jnp.maximum(e, 0)
-                ok = ((e >= 0) & (trip_ref[ec, 0] == rip_l[0])
-                      & (trip_ref[ec, 1] == rip_l[1]))
+                ok = ((e >= 0) & (trip_ref[ec, 0] == key_l[0])
+                      & (trip_ref[ec, 1] == key_l[1]))
                 return jnp.where((found < 0) & ok, e, found)
 
             return lax.fori_loop(0, PROBES, body, jnp.int32(-1))
 
         def slot_of(pfn):
-            """frame_slot: pfn -> image page slot (0 = absent/zero page)."""
+            """frame_slot: pfn -> image page slot (0 = absent/zero page),
+            through the lane's tenant row of the stacked frame table."""
             in_range = (pfn >= 0) & (pfn < nframes)
             safe = jnp.clip(pfn, 0, nframes - 1)
-            return jnp.where(in_range, ftab_ref[safe], 0)
+            return jnp.where(in_range, ftab_ref[tenant, safe], 0)
 
         def step_body(_, carry):
             gl, rip_l, rf_lo, status, ic_l, bpskip, d_instr, d_miss = carry
@@ -361,10 +370,11 @@ def make_run_fused(k_steps: int, interpret: Optional[bool] = None):
     @jax.jit
     def run_fused(tab: UopTable, image: MemImage, machine: Machine, limit):
         n_lanes = machine.status.shape[0]
+        image = lane_image(image, n_lanes)
         n_fields = tab.meta_i32.shape[1]
         hash_size = tab.hash_tab.shape[0]
         capacity = tab.rip_l.shape[0]
-        nframes = image.frame_table.shape[0]
+        n_tenants, nframes = image.frame_table.shape
         slots = machine.overlay.pfn.shape[1]
         cov_w = machine.cov.shape[1]
         edge_w = machine.edge.shape[1]
@@ -401,9 +411,10 @@ def make_run_fused(k_steps: int, interpret: Optional[bool] = None):
                 full((capacity, n_fields)),
                 full((capacity, 8)),
                 full((n_slots_img, 2 * PAGE_WORDS)),
-                full((nframes,)),
+                full((n_tenants, nframes)),
                 lane((slots,)),
                 full((2,)),
+                lane(()),
                 lane((16, 2)),
                 lane((2,)),
                 lane((2,)),
@@ -438,7 +449,7 @@ def make_run_fused(k_steps: int, interpret: Optional[bool] = None):
             ],
             interpret=interpret,
         )(tab.hash_tab, tab.rip_l, tab.meta_i32, tmu32, pages32,
-          image.frame_table, machine.overlay.pfn, limit32,
+          image.frame_table, machine.overlay.pfn, limit32, image.tenant,
           machine.gpr_l, machine.rip_l, machine.rflags_l, machine.status,
           ic32, machine.bp_skip, machine.ctr, machine.cov, machine.edge)
         gpr_l, rip_l, rf_l, status, ic_out, bp_skip, ctr, cov, edge = out
@@ -479,12 +490,13 @@ def make_run_resume(n_steps: int, donate: bool = None):
 
     from wtf_tpu.interp.step import step_lane
 
-    step_v = jax.vmap(step_lane, in_axes=(None, None, 0, None))
+    step_v = jax.vmap(step_lane, in_axes=(None, IMAGE_IN_AXES, 0, None))
     running = jnp.int32(_RUNNING)
     parked = jnp.int32(_NEEDS_XLA)
 
     @partial(jax.jit, donate_argnums=(2,) if donate else ())
     def run_resume(tab: UopTable, image: MemImage, machine: Machine, limit):
+        image = lane_image(image, machine.status.shape[0])
         st = machine.status
         machine = machine._replace(status=jnp.where(
             st == parked, running, jnp.where(st == running, parked, st)))

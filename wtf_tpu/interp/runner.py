@@ -173,8 +173,12 @@ class HostView:
         return StatusCode(int(self.r["status"][lane]))
 
     # -- physical memory -------------------------------------------------
-    def _base_page(self, pfn: int) -> bytes:
-        return self.runner.physmem.host_read(pfn << PAGE_SHIFT, PAGE_SIZE)
+    def _base_page(self, lane: int, pfn: int) -> bytes:
+        # routed per lane: heterogeneous batches read the LANE's base
+        # image (wtf_tpu/tenancy); single-image runners route to the one
+        # physmem as before
+        return self.runner.lane_physmem(lane).host_read(
+            pfn << PAGE_SHIFT, PAGE_SIZE)
 
     def _device_overlay_page(self, lane: int, pfn: int) -> Optional[bytes]:
         slots = np.nonzero(self._ov_pfn[lane] == pfn)[0]
@@ -186,7 +190,7 @@ class HostView:
         valid = np.asarray(ov.valid[lane, slot])
         # delta row: only valid words come from the overlay, the rest
         # from the base image (little-endian words -> bytes on a LE host)
-        base = np.frombuffer(self._base_page(pfn), dtype=np.uint64)
+        base = np.frombuffer(self._base_page(lane, pfn), dtype=np.uint64)
         return np.where(valid != 0, data, base).tobytes()
 
     def page(self, lane: int, pfn: int) -> bytes:
@@ -198,7 +202,7 @@ class HostView:
         if cached is None:
             cached = self._device_overlay_page(lane, pfn)
             if cached is None:
-                cached = self._base_page(pfn)
+                cached = self._base_page(lane, pfn)
             self._page_cache[key] = cached
         return cached
 
@@ -499,7 +503,7 @@ _apply_page_writes_plain = jax.jit(_apply_page_writes)
 
 @lru_cache(maxsize=None)
 def _make_device_insert(n_pages: int, n_words: int, len_gpr: int,
-                        ptr_gpr: int, donate: bool):
+                        ptr_gpr: int, donate: bool, masked: bool = False):
     """The fused insert seam for device-generated testcases (wtf_tpu/
     devmut): one in-graph update that lands a whole batch's bytes in the
     per-lane overlay and sets the target ABI registers — the
@@ -519,7 +523,12 @@ def _make_device_insert(n_pages: int, n_words: int, len_gpr: int,
     pad = n_pages * (PAGE_SIZE // 4) - n_words
     assert pad >= 0, "testcase words exceed the insert region"
 
-    def impl(machine: Machine, words, lens, pfns, gva_l):
+    def impl(machine: Machine, words, lens, pfns, gva_l, *rest):
+        # `masked` variant (wtf_tpu/tenancy): `active` (bool[L]) limits
+        # the insert to one tenant's lanes — inactive lanes keep their
+        # overlay rows, counters, status and ABI registers untouched, so
+        # per-tenant device batches land with one dispatch per tenant.
+        active = rest[0] if masked else None
         n_lanes = machine.status.shape[0]
         w = jnp.pad(words, ((0, 0), (0, pad))) if pad else words
         rows = limbs.pack_u64(
@@ -529,15 +538,19 @@ def _make_device_insert(n_pages: int, n_words: int, len_gpr: int,
         # retire rows already holding an insert-region pfn (a pushed
         # host write into the input region; slot leaks until restore)
         dead = (ov.pfn[:, :, None] == pfns[None, None, :]).any(-1)
+        if active is not None:
+            dead = dead & active[:, None]
         pfn0 = jnp.where(dead, jnp.int32(-1), ov.pfn)
         start = ov.count                                   # i32[L]
-        ok = start + jnp.int32(n_pages) <= jnp.int32(capacity)
+        can = start + jnp.int32(n_pages) <= jnp.int32(capacity)
+        ok = can if active is None else (can & active)
         li = lax.broadcasted_iota(jnp.int32, (n_lanes, n_pages), 0)
         ridx = jnp.minimum(start[:, None]
                            + lax.broadcasted_iota(
                                jnp.int32, (n_lanes, n_pages), 1),
                            jnp.int32(capacity - 1))
         sel = ok[:, None]
+        full = ~can if active is None else (active & ~can)
         overlay = ov._replace(
             data=ov.data.at[li, ridx].set(
                 jnp.where(sel[..., None], rows, ov.data[li, ridx])),
@@ -548,16 +561,27 @@ def _make_device_insert(n_pages: int, n_words: int, len_gpr: int,
                 jnp.where(sel, jnp.broadcast_to(pfns, (n_lanes, n_pages)),
                           pfn0[li, ridx])),
             count=jnp.where(ok, start + jnp.int32(n_pages), start),
-            overflow=ov.overflow | ~ok,
+            overflow=ov.overflow | full,
         )
         status = jnp.where(
-            ~ok & (machine.status == jnp.int32(int(StatusCode.RUNNING))),
+            full & (machine.status == jnp.int32(int(StatusCode.RUNNING))),
             jnp.int32(int(StatusCode.OVERLAY_FULL)), machine.status)
         gpr = machine.gpr_l
-        gpr = gpr.at[:, len_gpr, 0].set(lens.astype(jnp.uint32))
-        gpr = gpr.at[:, len_gpr, 1].set(jnp.uint32(0))
-        gpr = gpr.at[:, ptr_gpr, 0].set(gva_l[0])
-        gpr = gpr.at[:, ptr_gpr, 1].set(gva_l[1])
+        if active is None:
+            gpr = gpr.at[:, len_gpr, 0].set(lens.astype(jnp.uint32))
+            gpr = gpr.at[:, len_gpr, 1].set(jnp.uint32(0))
+            gpr = gpr.at[:, ptr_gpr, 0].set(gva_l[0])
+            gpr = gpr.at[:, ptr_gpr, 1].set(gva_l[1])
+        else:
+            gpr = gpr.at[:, len_gpr, 0].set(
+                jnp.where(active, lens.astype(jnp.uint32),
+                          gpr[:, len_gpr, 0]))
+            gpr = gpr.at[:, len_gpr, 1].set(
+                jnp.where(active, jnp.uint32(0), gpr[:, len_gpr, 1]))
+            gpr = gpr.at[:, ptr_gpr, 0].set(
+                jnp.where(active, gva_l[0], gpr[:, ptr_gpr, 0]))
+            gpr = gpr.at[:, ptr_gpr, 1].set(
+                jnp.where(active, gva_l[1], gpr[:, ptr_gpr, 1]))
         return machine._replace(overlay=overlay, gpr_l=gpr,
                                 status=status)
 
@@ -587,6 +611,7 @@ class Runner:
         fused_rounds: int = 8,
         fused_resume_steps: int = 1,
         burst_any_tier: Optional[bool] = None,
+        tenants=None,
     ):
         # Telemetry: metrics registry (private unless the backend/CLI hands
         # in a shared one) + JSONL event sink (NULL swallows when unwired)
@@ -594,29 +619,52 @@ class Runner:
         self.events = events if events is not None else NULL
         self.snapshot = snapshot
         self.physmem = snapshot.physmem
-        # the image operand executors dispatch against (a mesh runner
-        # re-points this at a replicated placement; host-side page reads
-        # keep going through self.physmem)
-        self.image = snapshot.physmem.image
         # extra executor-identity tag mixed into compile-event keys
         # (mesh runners dispatch different programs at the same shapes)
         self.exec_sig: Tuple = ()
-        self.cpu0 = snapshot.cpu
         self.n_lanes = n_lanes
         self.cache = DecodeCache(capacity=uop_capacity)
-        self.machine = machine_init(
-            snapshot.cpu, n_lanes, uop_capacity, overlay_slots, edge_bits)
-        self.template = machine_init(
-            snapshot.cpu, n_lanes, uop_capacity, overlay_slots=0,
-            edge_bits=edge_bits)
+        if tenants is None:
+            # the image operand executors dispatch against (a mesh runner
+            # re-points this at a replicated placement; host-side page
+            # reads keep going through self.physmem)
+            self.image = snapshot.physmem.image
+            self.machine = machine_init(
+                snapshot.cpu, n_lanes, uop_capacity, overlay_slots,
+                edge_bits)
+            self.template = machine_init(
+                snapshot.cpu, n_lanes, uop_capacity, overlay_slots=0,
+                edge_bits=edge_bits)
+            self.tenant_of_lane = np.zeros(n_lanes, dtype=np.int32)
+            self._physmems = [snapshot.physmem]
+            self._cpu0s = [snapshot.cpu]
+        else:
+            # heterogeneous batch (wtf_tpu/tenancy): per-lane base-image
+            # ids over a stacked image table; per-lane machine state
+            # initialized from each tenant's CpuState.  `snapshot` is the
+            # table's primary (tenant 0) for the compat surfaces above.
+            from wtf_tpu.tenancy.image import build_batch_state
+
+            built = build_batch_state(tenants, n_lanes, uop_capacity,
+                                      overlay_slots, edge_bits)
+            self.image = built.image
+            self.machine = built.machine
+            self.template = built.template
+            self.tenant_of_lane = built.tenant_of_lane
+            self._physmems = built.physmems
+            self._cpu0s = built.cpus
         self.limit = 0
         self.chunk_steps = chunk_steps
         # Guest exception delivery (reference: every fault is serviced by
         # the guest through bochs' IDT emulation / KVM event injection).
         # Auto mode turns it on exactly when the snapshot carries an IDT;
         # IDT-less synthetic guests keep the terminal-fault behavior.
+        # Heterogeneous batches gate per lane: the servicing loop only
+        # delivers through tenants that carry an IDT (cpu0_of), so an
+        # IDT-less tenant's faults stay terminal exactly as they do solo.
         if deliver_exceptions is None:
-            deliver_exceptions = snapshot.cpu.idtr.limit > 0
+            deliver_exceptions = any(
+                cpu.idtr.limit > 0 for cpu in self._cpu0s)
         self.deliver_exceptions = deliver_exceptions
         # Donation only off-CPU: XLA CPU miscompiles donated machines on
         # this graph (see make_run_chunk's caveat) and donation buys
@@ -697,6 +745,23 @@ class Runner:
             labeled=("fallbacks_by_opclass",))
         self.stats["max_chunk_steps"] = chunk_steps
 
+    # -- per-lane tenant routing (wtf_tpu/tenancy; single-image batches
+    # are tenant 0 everywhere) ----------------------------------------------
+    def tenant_of(self, lane: int) -> int:
+        return int(self.tenant_of_lane[lane])
+
+    def cpu0_of(self, lane: int):
+        """The lane's snapshot CpuState (oracle fallback segments/x87,
+        IDT/TSS anchors for exception delivery)."""
+        return self._cpu0s[self.tenant_of(lane)]
+
+    def lane_physmem(self, lane: int):
+        """The lane's base-image PhysMem (host-side page reads)."""
+        return self._physmems[self.tenant_of(lane)]
+
+    def _deliver_lane(self, lane: int) -> bool:
+        return self._cpu0s[self.tenant_of(lane)].idtr.limit > 0
+
     # -- device dispatch surface (the seams MeshRunner re-points) ----------
     def device_tab(self):
         """The dispatch-ready uop table (mesh runners hand back a
@@ -737,7 +802,9 @@ class Runner:
         restored to the snapshot."""
         return {
             "cache": self.cache.checkpoint_entries(),
-            "smc_updates": dict(self._smc_updates),
+            # (tenant, rip) keys flatten to JSON-able triples
+            "smc_updates": [[t, r, n]
+                            for (t, r), n in self._smc_updates.items()],
         }
 
     def restore_state(self, state: dict) -> None:
@@ -745,9 +812,14 @@ class Runner:
         runner (empty decode cache; breakpoints from target.init may
         already be pending — add() re-arms them)."""
         self.cache.restore_entries(state.get("cache", []))
-        self._smc_updates = {int(k): int(v)
-                             for k, v in state.get("smc_updates",
-                                                   {}).items()}
+        smc = state.get("smc_updates", [])
+        if isinstance(smc, dict):
+            # pre-tenancy checkpoints: {rip: n} means tenant 0
+            self._smc_updates = {(0, int(k)): int(v)
+                                 for k, v in smc.items()}
+        else:
+            self._smc_updates = {(int(t), int(r)): int(n)
+                                 for t, r, n in smc}
 
     # -- trace-capture hooks (ablate.py / bench.py / wtf_tpu.analysis) -----
     def executor_operands(self) -> Tuple:
@@ -772,7 +844,7 @@ class Runner:
 
     # -- mutate-on-device insert seam (wtf_tpu/devmut) ---------------------
     def device_insert(self, words, lens, pfns, gva: int,
-                      len_gpr: int, ptr_gpr: int) -> None:
+                      len_gpr: int, ptr_gpr: int, active=None) -> None:
         """Insert a device-generated batch without a host round-trip:
         `words` (u32[L, W]) / `lens` (i32[L]) — typically straight from
         devmut's generate dispatch — land in overlay slots [0, n_pages)
@@ -787,19 +859,22 @@ class Runner:
                 f"device-insert region spans {n_pages} pages but lanes "
                 f"have only {capacity} overlay slots — raise "
                 f"overlay_slots or shrink the mutator/spec max_len")
+        masked = active is not None
         fn = _make_device_insert(n_pages, words.shape[1], len_gpr, ptr_gpr,
-                                 self._donate)
+                                 self._donate, masked=masked)
         key = ("devins", n_pages, words.shape[1], len_gpr, ptr_gpr,
-               self.n_lanes, self._donate, self.exec_sig)
+               self.n_lanes, self._donate, masked, self.exec_sig)
         if key not in _DISPATCHED_EXECUTORS:
             _DISPATCHED_EXECUTORS.add(key)
             self.events.emit("compile", kind="device-insert",
                              pages=n_pages, words=int(words.shape[1]))
         gva_l = np.array([gva & 0xFFFF_FFFF, (gva >> 32) & 0xFFFF_FFFF],
                          dtype=np.uint32)
+        extra = (jnp.asarray(np.asarray(active, dtype=bool)),) if masked \
+            else ()
         self.machine = fn(self.machine, words, lens,
                           jnp.asarray(np.asarray(pfns, dtype=np.int32)),
-                          jnp.asarray(gva_l))
+                          jnp.asarray(gva_l), *extra)
 
     def push(self, view: HostView) -> None:
         """Apply a HostView's mutations (registers + buffered page writes +
@@ -874,7 +949,7 @@ class Runner:
             pfn1 = view.translate(lane, rip + max(uop.length - 1, 0)) >> PAGE_SHIFT
         except HostFault:
             pfn1 = pfn0
-        self.cache.add(rip, uop, pfn0, pfn1)
+        self.cache.add(rip, uop, pfn0, pfn1, tenant=self.tenant_of(lane))
         self.stats["decodes"] += 1
         self._prefetch_block(view, lane, uop, rip)
         return True
@@ -909,12 +984,13 @@ class Runner:
             return (nxt,)
 
         budget = self.PREFETCH_BUDGET
+        tenant = self.tenant_of(lane)
         work = list(succs(uop, rip))
         while work and budget > 0:
             if self.cache.count >= self.cache.capacity - self._PREFETCH_MARGIN:
                 return
             at = work.pop()
-            if at in self.cache.index:
+            if self.cache.has(at, tenant):
                 continue
             try:
                 window = view.virt_read(lane, at, 15)
@@ -929,27 +1005,29 @@ class Runner:
                     lane, at + max(u2.length - 1, 0)) >> PAGE_SHIFT
             except HostFault:
                 pfn1 = pfn0
-            self.cache.add(at, u2, pfn0, pfn1)
+            self.cache.add(at, u2, pfn0, pfn1, tenant=tenant)
             self.stats["decodes_prefetched"] += 1
             budget -= 1
             work.extend(succs(u2, at))
 
     def _service_decode(self, view: HostView, lanes: List[int]) -> None:
-        done: Set[int] = set()
+        done: Set[Tuple[int, int]] = set()
         for lane in lanes:
             rip = view.get_rip(lane)
-            if rip not in done:
-                if rip not in self.cache.index:
+            key = (self.tenant_of(lane), rip)
+            if key not in done:
+                if not self.cache.has(rip, key[0]):
                     if not self._decode_at(view, lane, rip):
                         continue
-                done.add(rip)
+                done.add(key)
             view.set_status(lane, StatusCode.RUNNING)
 
     def _service_smc(self, view: HostView, lanes: List[int]) -> None:
         for lane in lanes:
             rip = view.get_rip(lane)
-            n = self._smc_updates.get(rip, 0) + 1
-            self._smc_updates[rip] = n
+            skey = (self.tenant_of(lane), rip)
+            n = self._smc_updates.get(skey, 0) + 1
+            self._smc_updates[skey] = n
             if n > 16:
                 # cache thrash: lanes disagree about the bytes at this rip;
                 # fall back to the oracle for this lane instead of ping-
@@ -968,7 +1046,7 @@ class Runner:
                 pfn1 = view.translate(lane, rip + max(uop.length - 1, 0)) >> PAGE_SHIFT
             except HostFault:
                 pfn1 = pfn0
-            self.cache.update(rip, uop, pfn0, pfn1)
+            self.cache.update(rip, uop, pfn0, pfn1, tenant=skey[0])
             self.stats["smc_updates"] += 1
             view.set_status(lane, StatusCode.RUNNING)
 
@@ -979,12 +1057,12 @@ class Runner:
         # per-opclass attribution (VERDICT r5 item 3: a campaign's fallback
         # total was a single opaque number — e.g. real_pe's 1321 — with no
         # way to tell WHICH instruction classes keep diverting)
-        uop = self.cache.uops.get(view.get_rip(lane))
+        uop = self.cache.uop_at(view.get_rip(lane), self.tenant_of(lane))
         opclass = (_OPC_NAMES.get(uop.opc, f"opc{uop.opc}")
                    if uop is not None else "undecoded")
         by_class = self.stats["fallbacks_by_opclass"]
         by_class[opclass] = by_class.get(opclass, 0) + 1
-        cpu_state = _lane_cpu_state(view, lane, self.cpu0)
+        cpu_state = _lane_cpu_state(view, lane, self.cpu0_of(lane))
         emu = EmuCpu(_FallbackMem(view, lane), cpu_state)
         icount_before = int(view.r["icount"][lane])
         emu.icount = icount_before
@@ -1017,7 +1095,8 @@ class Runner:
         view.r["ctr"][lane, CTR_INSTR] += np.uint32(emu.icount - icount_before)
         view.r["rdrand"][lane] = np.uint64(emu.rdrand_state)
         view.r["bp_skip"][lane] = np.int32(0)
-        if emu.cr3_event is not None and emu.cr3_event != self.cpu0.cr3:
+        if emu.cr3_event is not None \
+                and emu.cr3_event != self.cpu0_of(lane).cr3:
             view.set_status(lane, StatusCode.CR3_CHANGE)
         elif self.limit and emu.icount >= self.limit:
             view.set_status(lane, StatusCode.TIMEDOUT)
@@ -1050,7 +1129,8 @@ class Runner:
                   rip: int) -> Optional[Tuple[int, "U.Uop"]]:
         """(uop-table entry index, uop) at `rip`, publishing the decode on
         a miss; None when the bytes can't be fetched or don't decode."""
-        uop = self.cache.uops.get(rip)
+        tenant = self.tenant_of(lane)
+        uop = self.cache.uop_at(rip, tenant)
         if uop is None:
             try:
                 window = view.virt_read(lane, rip, 15)
@@ -1065,8 +1145,8 @@ class Runner:
                     lane, rip + max(uop.length - 1, 0)) >> PAGE_SHIFT
             except HostFault:
                 pfn1 = pfn0
-            self.cache.add(rip, uop, pfn0, pfn1)
-        return self.cache.index[rip], uop
+            self.cache.add(rip, uop, pfn0, pfn1, tenant=tenant)
+        return self.cache.entry_index(rip, tenant), uop
 
     def _is_oracle_uop(self, uop) -> bool:
         return (uop.opc in self._ORACLE_OPCS
@@ -1116,7 +1196,7 @@ class Runner:
             if view.get_status(lane) != StatusCode.RUNNING:
                 return
             rip = view.get_rip(lane)
-            if self.cache.has_breakpoint(rip):
+            if self.cache.has_breakpoint(rip, self.tenant_of(lane)):
                 return
             entry = self._entry_at(view, lane, rip)
             if entry is None:
@@ -1150,7 +1230,7 @@ class Runner:
         terminal status and the crash naming that comes with it.  Returns
         whether the exception was delivered."""
         status = view.get_status(lane)
-        ctx = _LaneCtx(view, lane, self.cpu0)
+        ctx = _LaneCtx(view, lane, self.cpu0_of(lane))
         try:
             if status == StatusCode.PAGE_FAULT:
                 gva = int(view.r["fault_gva"][lane])
@@ -1256,8 +1336,15 @@ class Runner:
                     # lanes) dispatches warm and must not re-report a
                     # compile.
                     _DISPATCHED_EXECUTORS.add(compile_key)
+                    # the image tag keeps scheduler placements with
+                    # different stacked-image shapes (wtf_tpu/tenancy)
+                    # from reading as shape-churn in telemetry_report
                     self.events.emit("compile", chunk_steps=size,
-                                     donate=self._donate)
+                                     donate=self._donate,
+                                     lanes=self.n_lanes,
+                                     image="x".join(
+                                         str(d) for d in
+                                         self.image.frame_table.shape))
                 with spans.span("device-step") as sp:
                     self.machine = run_chunk(
                         tab, self.image, self.machine, limit)
@@ -1281,9 +1368,18 @@ class Runner:
             if self.deliver_exceptions:
                 need[int(StatusCode.PAGE_FAULT)] = []
                 need[int(StatusCode.DIVIDE_ERROR)] = []
+            fault_statuses = (int(StatusCode.PAGE_FAULT),
+                              int(StatusCode.DIVIDE_ERROR))
             for lane in np.nonzero(np.isin(status, list(need)))[0]:
                 if int(lane) in undeliverable:
                     continue  # delivery already failed: stays terminal
+                if (int(status[lane]) in fault_statuses
+                        and not self._deliver_lane(int(lane))):
+                    # heterogeneous batch: this lane's tenant has no IDT
+                    # — its faults are terminal, exactly as they are in
+                    # a solo campaign of that tenant
+                    undeliverable.add(int(lane))
+                    continue
                 need[int(status[lane])].append(int(lane))
             total = sum(len(v) for v in need.values())
             if total == 0:
@@ -1413,7 +1509,7 @@ def warm_decode_cache(runner: Runner, target, payload: bytes,
     view = runner.view()
     n = 0
     for rip in sorted(eb.last_new_coverage()):
-        if rip not in runner.cache.index:
+        if not runner.cache.has(rip):
             runner._decode_at(view, 0, rip)
             n += 1
     return n

@@ -64,7 +64,7 @@ from wtf_tpu.mem.overlay import (
     extract_pair, load_windows3_vec, store_window3,
 )
 from wtf_tpu.mem.paging import Translation, translate_vec_l
-from wtf_tpu.mem.physmem import MemImage
+from wtf_tpu.mem.physmem import IMAGE_IN_AXES, MemImage, lane_image
 
 MASK64 = (1 << 64) - 1
 
@@ -645,7 +645,18 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     overlay = st.overlay
 
     # -- 1. decode-cache lookup (u32-only hash probe) -------------------
-    idx = uop_lookup(tab, rip_l)
+    # Heterogeneous batches (wtf_tpu/tenancy) probe a TENANT-TAGGED key:
+    # rip ^ (tenant << 48).  Canonical rips keep bits 62:48 as sign bits,
+    # so the tag never collides with a real address and two base images
+    # sharing a virtual address resolve to distinct cache entries (each
+    # with its own raw bytes / code pfns — no cross-tenant SMC thrash).
+    # Single-image dispatch (tenant=None) probes the bare rip: key == rip.
+    if image.tenant is None:
+        key_l = rip_l
+    else:
+        ttag = (image.tenant.astype(jnp.uint32) << 16)  # bit 48 = hi bit 16
+        key_l = (rip_l[0], rip_l[1] ^ ttag)
+    idx = uop_lookup(tab, key_l)
     miss = enabled & (idx < 0)
     idxc = jnp.maximum(idx, 0)
 
@@ -2272,9 +2283,14 @@ def make_run_chunk(n_steps: int, donate: bool = None, jit: bool = True):
         if cached is not None:
             return cached
 
-    step_v = jax.vmap(step_lane, in_axes=(None, None, 0, None))
+    step_v = jax.vmap(step_lane, in_axes=(None, IMAGE_IN_AXES, 0, None))
 
     def run_chunk(tab: UopTable, image: MemImage, machine: Machine, limit):
+        # normalize in-body: the per-lane tenant selector is always
+        # populated past this point (zeros for single-image callers), so
+        # one vmap structure serves both dispatch shapes
+        image = lane_image(image, machine.status.shape[0])
+
         def cond(carry):
             i, m = carry
             return (i < n_steps) & jnp.any(

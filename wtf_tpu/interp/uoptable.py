@@ -87,6 +87,18 @@ M_BP = NF + 2
 # meta_u64 column layout
 MU_DISP, MU_IMM, MU_RAW_LO, MU_RAW_HI = 0, 1, 2, 3
 
+_MASK64 = (1 << 64) - 1
+
+
+def tag_key(rip: int, tenant: int = 0) -> int:
+    """The probe key a (tenant, rip) pair hashes and verifies under
+    (wtf_tpu/tenancy): rip ^ (tenant << 48).  Canonical x86-64 addresses
+    carry bits 62:48 as copies of bit 47, so the tag occupies dead bits
+    and two base images sharing a virtual address get distinct cache
+    entries.  tenant 0 (every single-image campaign) leaves the rip
+    untouched — the pre-tenancy key space, bit for bit."""
+    return (rip ^ (tenant << 48)) & _MASK64
+
 
 def _pack_raw(raw: bytes) -> Tuple[int, int]:
     padded = raw[:16].ljust(16, b"\x00")
@@ -104,7 +116,10 @@ class DecodeCache:
         while self.hash_size < capacity * hash_factor:
             self.hash_size *= 2
         self.count = 0
+        # self.rip holds the PROBE KEY per entry (tag_key(rip, tenant));
+        # tenant_of untags it back to the real rip for reporting
         self.rip = np.zeros(capacity, dtype=np.uint64)
+        self.tenant_of = np.zeros(capacity, dtype=np.int32)
         self.fields = np.zeros((capacity, NF), dtype=np.int32)
         self.disp = np.zeros(capacity, dtype=np.uint64)
         self.imm = np.zeros(capacity, dtype=np.uint64)
@@ -114,12 +129,23 @@ class DecodeCache:
         self.pfn1 = np.zeros(capacity, dtype=np.int32)
         self.bp = np.zeros(capacity, dtype=np.int32)
         self.hash_tab = np.full(self.hash_size, -1, dtype=np.int32)
-        self.index: Dict[int, int] = {}      # rip -> entry idx
-        self.uops: Dict[int, Uop] = {}       # rip -> host Uop (debug/oracle)
+        self.index: Dict[int, int] = {}      # probe key -> entry idx
+        self.uops: Dict[int, Uop] = {}       # probe key -> host Uop
         # Breakpoints may be registered before their rip is ever decoded
         # (symbol breakpoints at Init time, reference backend.cc:214-239).
+        # Keyed like entries: tag_key(gva, tenant).
         self.pending_bps: Set[int] = set()
         self._device: Optional[UopTable] = None
+
+    # -- keyed lookups (tenant 0 == the pre-tenancy rip key space) -------
+    def entry_index(self, rip: int, tenant: int = 0) -> Optional[int]:
+        return self.index.get(tag_key(rip, tenant))
+
+    def has(self, rip: int, tenant: int = 0) -> bool:
+        return tag_key(rip, tenant) in self.index
+
+    def uop_at(self, rip: int, tenant: int = 0) -> Optional[Uop]:
+        return self.uops.get(tag_key(rip, tenant))
 
     # -- insertion -------------------------------------------------------
     def _hash_insert(self, rip: int, idx: int) -> bool:
@@ -143,9 +169,11 @@ class DecodeCache:
                 return
             self.hash_size *= 2
 
-    def add(self, rip: int, uop: Uop, pfn0: int, pfn1: int) -> int:
+    def add(self, rip: int, uop: Uop, pfn0: int, pfn1: int,
+            tenant: int = 0) -> int:
         """Insert a decoded instruction; returns its entry index."""
-        existing = self.index.get(rip)
+        key = tag_key(rip, tenant)
+        existing = self.index.get(key)
         if existing is not None:
             return existing
         if self.count >= self.capacity:
@@ -154,7 +182,8 @@ class DecodeCache:
             )
         idx = self.count
         self.count += 1
-        self.rip[idx] = np.uint64(rip)
+        self.rip[idx] = np.uint64(key)
+        self.tenant_of[idx] = tenant
         for f, name in enumerate(INT_FIELDS):
             self.fields[idx, f] = getattr(uop, name)
         self.disp[idx] = np.uint64(uop.disp & ((1 << 64) - 1))
@@ -164,22 +193,24 @@ class DecodeCache:
         self.raw_hi[idx] = np.uint64(hi)
         self.pfn0[idx] = pfn0
         self.pfn1[idx] = pfn1
-        self.bp[idx] = 1 if rip in self.pending_bps else 0
-        if not self._hash_insert(rip, idx):
+        self.bp[idx] = 1 if key in self.pending_bps else 0
+        if not self._hash_insert(key, idx):
             self._rehash()
-        self.index[rip] = idx
-        self.uops[rip] = uop
+        self.index[key] = idx
+        self.uops[key] = uop
         self._device = None
         return idx
 
-    def update(self, rip: int, uop: Uop, pfn0: int, pfn1: int) -> int:
+    def update(self, rip: int, uop: Uop, pfn0: int, pfn1: int,
+               tenant: int = 0) -> int:
         """Re-publish a rip whose bytes changed (self-modifying code / SMC
         servicing).  Overwrites the existing entry in place — the entry index
         is stable, so coverage-bitmap indices stay valid — or inserts when
         the rip was never decoded."""
-        idx = self.index.get(rip)
+        key = tag_key(rip, tenant)
+        idx = self.index.get(key)
         if idx is None:
-            return self.add(rip, uop, pfn0, pfn1)
+            return self.add(rip, uop, pfn0, pfn1, tenant=tenant)
         for f, name in enumerate(INT_FIELDS):
             self.fields[idx, f] = getattr(uop, name)
         self.disp[idx] = np.uint64(uop.disp & ((1 << 64) - 1))
@@ -189,7 +220,7 @@ class DecodeCache:
         self.raw_hi[idx] = np.uint64(hi)
         self.pfn0[idx] = pfn0
         self.pfn1[idx] = pfn1
-        self.uops[rip] = uop
+        self.uops[key] = uop
         self._device = None
         return idx
 
@@ -205,42 +236,51 @@ class DecodeCache:
         uops/raw in sync), exactly the state the killed run held."""
         out = []
         for idx in range(self.count):
-            rip = int(self.rip[idx])
-            uop = self.uops[rip]
-            out.append((rip, uop.raw, int(self.pfn0[idx]),
-                        int(self.pfn1[idx])))
+            key = int(self.rip[idx])
+            tenant = int(self.tenant_of[idx])
+            uop = self.uops[key]
+            entry = (tag_key(key, tenant), uop.raw, int(self.pfn0[idx]),
+                     int(self.pfn1[idx]))
+            # tenant rides as a 5th element only when nonzero, so
+            # pre-tenancy checkpoints round-trip byte-identically
+            out.append(entry if tenant == 0 else entry + (tenant,))
         return out
 
     def restore_entries(self, entries) -> None:
-        """Rebuild from checkpoint_entries() output.  Requires an empty
-        cache — replaying into a partially-filled one would shift every
-        entry index and silently scramble restored coverage bitmaps."""
+        """Rebuild from checkpoint_entries() output (4-tuples, or
+        5-tuples carrying a tenant tag).  Requires an empty cache —
+        replaying into a partially-filled one would shift every entry
+        index and silently scramble restored coverage bitmaps."""
         if self.count:
             raise RuntimeError(
                 "decode-cache restore needs an empty cache "
                 f"(has {self.count} entries)")
         from wtf_tpu.cpu.decoder import decode
 
-        for rip, raw, pfn0, pfn1 in entries:
-            self.add(rip, decode(raw, rip), pfn0, pfn1)
+        for entry in entries:
+            rip, raw, pfn0, pfn1 = entry[:4]
+            tenant = int(entry[4]) if len(entry) > 4 else 0
+            self.add(rip, decode(raw, rip), pfn0, pfn1, tenant=tenant)
 
     # -- breakpoints -----------------------------------------------------
-    def set_breakpoint(self, gva: int) -> None:
-        self.pending_bps.add(gva)
-        idx = self.index.get(gva)
+    def set_breakpoint(self, gva: int, tenant: int = 0) -> None:
+        key = tag_key(gva, tenant)
+        self.pending_bps.add(key)
+        idx = self.index.get(key)
         if idx is not None and self.bp[idx] != 1:
             self.bp[idx] = 1
             self._device = None
 
-    def clear_breakpoint(self, gva: int) -> None:
-        self.pending_bps.discard(gva)
-        idx = self.index.get(gva)
+    def clear_breakpoint(self, gva: int, tenant: int = 0) -> None:
+        key = tag_key(gva, tenant)
+        self.pending_bps.discard(key)
+        idx = self.index.get(key)
         if idx is not None and self.bp[idx] != 0:
             self.bp[idx] = 0
             self._device = None
 
-    def has_breakpoint(self, gva: int) -> bool:
-        return gva in self.pending_bps
+    def has_breakpoint(self, gva: int, tenant: int = 0) -> bool:
+        return tag_key(gva, tenant) in self.pending_bps
 
     # -- device view -----------------------------------------------------
     def device(self) -> UopTable:
@@ -260,10 +300,28 @@ class DecodeCache:
         return self._device
 
     def rip_of(self, idx: int) -> int:
-        return int(self.rip[idx])
+        """Real (untagged) rip of an entry."""
+        return tag_key(int(self.rip[idx]), int(self.tenant_of[idx]))
+
+    def tenant_entries(self, tenant: int) -> list:
+        """This tenant's entries in insertion order, as (global entry
+        index, real rip, raw bytes, pfn0, pfn1) — the per-tenant slice a
+        tenancy checkpoint persists (wtf_tpu/tenancy/state.py); the
+        global indices are the tenant's coverage-bitmap remap."""
+        out = []
+        for idx in range(self.count):
+            if int(self.tenant_of[idx]) != tenant:
+                continue
+            key = int(self.rip[idx])
+            rip = tag_key(key, tenant)
+            uop = self.uops[key]
+            out.append((idx, rip, uop.raw, int(self.pfn0[idx]),
+                        int(self.pfn1[idx])))
+        return out
 
     def rips_of_bits(self, words: np.ndarray) -> list:
-        """Decode a coverage bitmap (u32 words over entry indices) to RIPs."""
+        """Decode a coverage bitmap (u32 words over entry indices) to
+        real (untagged) RIPs."""
         out = []
         bits = np.nonzero(words)[0]
         for word_idx in bits:
@@ -271,6 +329,8 @@ class DecodeCache:
             base = word_idx * 32
             while word:
                 low = word & -word
-                out.append(int(self.rip[base + low.bit_length() - 1]))
+                idx = base + low.bit_length() - 1
+                out.append(tag_key(int(self.rip[idx]),
+                                   int(self.tenant_of[idx])))
                 word ^= low
         return out

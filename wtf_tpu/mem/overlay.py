@@ -95,7 +95,7 @@ def overlay_reset(overlay: DirtyOverlay) -> DirtyOverlay:
 
 def split_gpa(image: MemImage, gpa: jax.Array):
     """gpa (uint64) -> (pfn int32 with OOB sentinel, offset int32)."""
-    nframes = image.frame_table.shape[0]
+    nframes = image.frame_table.shape[-1]
     pfn64 = gpa >> PAGE_SHIFT
     in_range = pfn64 < jnp.uint64(nframes)
     pfn = jnp.where(in_range, pfn64, jnp.uint64(_PFN_OOB)).astype(jnp.int32)

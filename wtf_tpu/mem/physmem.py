@@ -16,7 +16,7 @@ Guest writes NEVER touch this image — they go to the per-lane dirty overlay
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -43,10 +43,19 @@ class MemImage(NamedTuple):
     code fetch) read aligned word windows and extract bytes with shifts,
     cutting gather counts ~5-8x vs a byte-granular layout (a 16-byte
     unaligned access is 3 word gathers instead of 16 byte gathers; a PTE
-    read is 1 instead of 8)."""
+    read is 1 instead of 8).
+
+    Multi-tenancy (wtf_tpu/tenancy): `frame_table` carries a leading
+    TENANT axis — one pfn->slot row per base image, padded to a common
+    page-span layout — and the optional `tenant` leaf is the per-lane
+    row selector (int32[L] at dispatch; scalar under vmap).  A
+    single-snapshot image is the degenerate [1, span] table with
+    tenant=None (row 0 statically), so the pre-tenancy contract is
+    unchanged and the pytree gains no leaf."""
 
     pages: jax.Array       # uint64[slots, PAGE_WORDS]; slot 0 = zero page
-    frame_table: jax.Array # int32[nframes]; pfn -> slot (0 = absent/zero)
+    frame_table: jax.Array # int32[tenants, span]; pfn -> slot (0 = absent)
+    tenant: Optional[jax.Array] = None  # int32[L] lane -> frame-table row
 
 
 @dataclasses.dataclass
@@ -87,7 +96,7 @@ class PhysMem:
 
         image = MemImage(
             pages=jnp.asarray(packed.view(np.uint64)),  # LE word view
-            frame_table=jnp.asarray(frame_table),
+            frame_table=jnp.asarray(frame_table[None, :]),  # [1, span]
         )
         return cls(image=image, nframes=nframes, present=present)
 
@@ -101,7 +110,7 @@ class PhysMem:
         if not hasattr(self, "_host_pages"):
             # Cache host copies once; the image is immutable after build.
             self._host_pages = np.asarray(self.image.pages).view(np.uint8)
-            self._host_table = np.asarray(self.image.frame_table)
+            self._host_table = np.asarray(self.image.frame_table)[0]
         out = bytearray()
         pos = gpa
         end = gpa + size
@@ -115,9 +124,31 @@ class PhysMem:
         return bytes(out)
 
 
+# vmap in_axes for a dispatch image: pages/frame_table broadcast, the
+# per-lane tenant selector mapped.  Only valid for images normalized
+# through `lane_image` (tenant populated).
+IMAGE_IN_AXES = MemImage(pages=None, frame_table=None, tenant=0)
+
+
+def lane_image(image: MemImage, n_lanes: int) -> MemImage:
+    """Normalize a dispatch image so `tenant` is always a populated
+    int32[n_lanes] row selector (zeros for the single-image case) —
+    executors normalize in-body so legacy callers passing a bare
+    PhysMem image and tenancy runners share one vmap structure."""
+    if image.tenant is None:
+        return image._replace(tenant=jnp.zeros((n_lanes,), jnp.int32))
+    return image
+
+
 def frame_slot(image: MemImage, pfn: jax.Array) -> jax.Array:
-    """pfn (int32) -> slot, with out-of-range pfns mapping to the zero page."""
-    nframes = image.frame_table.shape[0]
-    in_range = (pfn >= 0) & (pfn < nframes)
-    safe_pfn = jnp.clip(pfn, 0, nframes - 1)
-    return jnp.where(in_range, image.frame_table[safe_pfn], 0)
+    """pfn (int32) -> slot, with out-of-range pfns mapping to the zero page.
+
+    The lane's frame-table row comes from `image.tenant` (the per-lane
+    base-image selector, scalar under the interpreter's vmap); tenant=None
+    is the single-image case and indexes row 0 statically — same program
+    as the pre-tenancy 1-D table."""
+    span = image.frame_table.shape[-1]
+    in_range = (pfn >= 0) & (pfn < span)
+    safe_pfn = jnp.clip(pfn, 0, span - 1)
+    row = jnp.int32(0) if image.tenant is None else image.tenant
+    return jnp.where(in_range, image.frame_table[row, safe_pfn], 0)

@@ -30,8 +30,14 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from wtf_tpu.interp.step import make_run_chunk
+from wtf_tpu.mem.physmem import MemImage
 from wtf_tpu.meshrun.mesh import LANE_AXIS
 from wtf_tpu.meshrun.reduce import bitplane_or
+
+# pages/frame table replicated on every chip; the per-lane tenant
+# selector (wtf_tpu/tenancy) shards with the lane axis.  Prefix specs
+# match images with tenant=None too (the empty subtree takes no spec).
+IMAGE_SPEC = MemImage(pages=P(), frame_table=P(), tenant=P(LANE_AXIS))
 
 _MESH_CHUNK_CACHE: dict = {}
 _MESH_FUSED_CACHE: dict = {}
@@ -73,7 +79,7 @@ def make_mesh_chunk(n_steps: int, mesh, donate: Optional[bool] = None,
     body = make_run_chunk(n_steps, donate=donate, jit=False)
     fn = shard_map(
         _chunk_with_coverage(body), mesh=mesh,
-        in_specs=(P(), P(), P(LANE_AXIS), P()),
+        in_specs=(P(), IMAGE_SPEC, P(LANE_AXIS), P()),
         out_specs=(P(LANE_AXIS), P(), P()),
         check_rep=False)
     if not jit:
@@ -100,7 +106,7 @@ def make_mesh_fused(k_steps: int, mesh):
         lambda tab, image, machine, limit: run_fused(
             tab, image, machine, limit),
         mesh=mesh,
-        in_specs=(P(), P(), P(LANE_AXIS), P()),
+        in_specs=(P(), IMAGE_SPEC, P(LANE_AXIS), P()),
         out_specs=P(LANE_AXIS),
         check_rep=False))
     _MESH_FUSED_CACHE[key] = fn
@@ -125,7 +131,7 @@ def make_mesh_resume(n_steps: int, mesh, donate: Optional[bool] = None):
     run_resume = make_run_resume(n_steps, donate=False)
     fn = jax.jit(shard_map(
         _chunk_with_coverage(run_resume), mesh=mesh,
-        in_specs=(P(), P(), P(LANE_AXIS), P()),
+        in_specs=(P(), IMAGE_SPEC, P(LANE_AXIS), P()),
         out_specs=(P(LANE_AXIS), P(), P()),
         check_rep=False), donate_argnums=(2,) if donate else ())
     _MESH_CHUNK_CACHE[key] = fn

@@ -83,7 +83,13 @@ class MeshRunner(Runner):
         self.exec_sig = ("mesh", self.mesh.size)
         self.machine = shard_machine(self.machine, self.mesh)
         self.template = shard_machine(self.template, self.mesh)
-        self.image = replicate(self.image, self.mesh)
+        # pages + frame table replicated; the per-lane tenant selector
+        # (wtf_tpu/tenancy heterogeneous batches) shards with the lanes
+        tenant = self.image.tenant
+        self.image = replicate(self.image._replace(tenant=None), self.mesh)
+        if tenant is not None:
+            self.image = self.image._replace(
+                tenant=jax.device_put(tenant, lane_sharding(self.mesh)))
         self._tab_src = None
         self._tab_repl = None
         self._slab_src = None

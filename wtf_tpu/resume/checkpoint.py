@@ -152,20 +152,18 @@ def _campaign_state(loop) -> dict:
     }
 
 
-def save_campaign(loop, directory) -> dict:
-    """Checkpoint `loop` into `directory` (created on demand).  Returns
-    {"path", "bytes", "batches"}.  Atomic: a kill at any point leaves
-    either the previous checkpoint, the new one, or the previous one
-    under `.prev` with the new one complete — never a torn file that
-    loads."""
+def write_checkpoint(state: dict, directory, corpus_items) -> dict:
+    """The atomic persistence tail shared by whole-campaign checkpoints
+    and per-tenant checkpoints (wtf_tpu/tenancy/state.py): content-
+    addressed corpus blobs (only new content costs a write), then the
+    digest-embedded doc written tmp+fsync+rename with one `.prev`
+    generation kept for torn-file fallback."""
     directory = Path(directory)
     blob_dir = directory / "corpus"
     blob_dir.mkdir(parents=True, exist_ok=True)
-    state = _campaign_state(loop)
-    # content-addressed blobs: only new content costs a write
     from wtf_tpu.utils.atomicio import atomic_write_bytes
 
-    for digest, data in zip(state["corpus_manifest"], loop.corpus):
+    for digest, data in zip(state["corpus_manifest"], corpus_items):
         path = blob_dir / digest
         if not path.exists():
             atomic_write_bytes(path, data)
@@ -182,7 +180,17 @@ def save_campaign(loop, directory) -> dict:
         path.replace(prev)  # keep one generation for torn-file fallback
     atomic_write_text(path, doc)
     return {"path": str(path), "bytes": len(doc),
-            "batches": state["batches"]}
+            "batches": state.get("batches", 0)}
+
+
+def save_campaign(loop, directory) -> dict:
+    """Checkpoint `loop` into `directory` (created on demand).  Returns
+    {"path", "bytes", "batches"}.  Atomic: a kill at any point leaves
+    either the previous checkpoint, the new one, or the previous one
+    under `.prev` with the new one complete — never a torn file that
+    loads."""
+    state = _campaign_state(loop)
+    return write_checkpoint(state, directory, list(loop.corpus))
 
 
 # ---------------------------------------------------------------------------

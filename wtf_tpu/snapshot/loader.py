@@ -44,7 +44,7 @@ class Snapshot:
         state_dir.mkdir(parents=True, exist_ok=True)
         image = self.physmem
         pages_np = np.asarray(image.image.pages)
-        table_np = np.asarray(image.image.frame_table)
+        table_np = np.asarray(image.image.frame_table)[0]
         pfns = np.nonzero(table_np)[0]
         slots = table_np[pfns]
         np.savez_compressed(

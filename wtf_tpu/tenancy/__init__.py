@@ -1,0 +1,40 @@
+"""Multi-tenant campaign scheduling over one device batch.
+
+The production story of ROADMAP item 3: one mesh serving *different*
+customers' campaigns concurrently, the same shape as multi-tenant
+inference serving — heterogeneous requests batched into one compiled
+program, jobs placed onto accelerator slices, preemption via durable
+state (the PR-8 checkpoint format is already placement-free).
+
+Two tiers:
+
+  heterogeneous batch axis (image.py + the interp/mem seams)
+      per-lane base-image ids index a STACKED image table — every
+      tenant's snapshot packed into one page store with one padded
+      pfn->slot row per tenant — and the decode cache keys entries by
+      (tenant, rip), so demo_tlv + demo_kernel + demo_pe lanes share
+      ONE run_batch dispatch and ONE compiled step ladder.  Tenant
+      identity is pure DATA (the `MemImage.tenant` lane selector):
+      the compiled program depends only on shapes, so any tenant mix
+      at a given lane count runs the same program bytes (pinned by
+      the lint's budget family).
+
+  scheduler tier (sched.py / loop.py / state.py / backend.py)
+      campaigns as jobs (`wtf-tpu sched` + jobs.json) placed onto lane
+      ranges of a (possibly mesh-sharded) batch, with priorities and
+      lane quotas; preemption checkpoints a tenant at a batch boundary
+      (reusing wtf_tpu/resume's format per tenant, coverage bit-planes
+      remapped to tenant-local entry indices so they are placement-
+      free), hands its lanes to another job, and resumes later
+      bit-identically.  Telemetry lands under per-tenant
+      `tenant.<name>.*` namespaces with tenant-tagged JSONL events.
+"""
+
+from wtf_tpu.tenancy.image import (  # noqa: F401
+    BatchState, build_batch_state, stack_images,
+)
+from wtf_tpu.tenancy.backend import (  # noqa: F401
+    TenancyBackend, TenancyMeshBackend, create_tenancy_backend,
+)
+from wtf_tpu.tenancy.loop import MultiTenantLoop, TenantRuntime  # noqa: F401
+from wtf_tpu.tenancy.sched import Job, Scheduler, load_jobs  # noqa: F401
