@@ -7,9 +7,9 @@
 PY ?= python
 
 .PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke \
-	triage-smoke tenancy-smoke
+	triage-smoke tenancy-smoke fleet-smoke
 
-verify: test lint chaos-smoke triage-smoke tenancy-smoke
+verify: test lint chaos-smoke triage-smoke tenancy-smoke fleet-smoke
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -54,6 +54,14 @@ triage-smoke:
 # bit-identical to an uninterrupted run
 tenancy-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.tenancy_smoke
+
+# fleet-tier soak (wtf_tpu/testing/fleet_smoke): 64 simulated clients
+# over the real WTF2/WTF3 wire with scripted frame drops + resets —
+# zero lost testcases, aggregate coverage byte-identical to a serial
+# replay, coverage wire bytes >=10x smaller than whole-bitmap
+# exchange, store fsck clean
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.fleet_smoke
 
 # deterministic fault-tolerance soak (wtf_tpu/testing/chaos_smoke):
 # seeded fault schedule over the real socket + checkpoint seams —

@@ -292,6 +292,38 @@ def measure_tenants_ramp(seconds=None, limit=50_000, lanes_per_tenant=None):
     return cells
 
 
+def measure_fleet(clients=None, runs_per_client=40, seed=0xF1EE7):
+    """Fleet-tier client-count ramp (wtf_tpu/fleet/soak): the same
+    deterministic soak workload at growing fan-out, measuring reactor
+    throughput (results/s) and the delta-vs-whole-bitmap coverage wire
+    ratio at each cell.  Paste the summary as FLEET_rNN.json."""
+    import logging
+    import tempfile
+
+    logging.getLogger("wtf_tpu").setLevel(logging.ERROR)
+    from wtf_tpu.fleet.soak import run_soak
+
+    cells = []
+    for n in (clients or (16, 64, 256)):
+        with tempfile.TemporaryDirectory() as tmp:
+            report = run_soak(tmp, clients=n,
+                              runs_per_client=runs_per_client,
+                              seed=seed, threads=min(16, max(n // 8, 1)),
+                              min_ratio=1.0)
+        cell = {k: report[k] for k in (
+            "clients", "runs", "accounted", "wall_s", "results_per_s",
+            "coverage", "retries", "reclaimed", "delta_cov_bytes",
+            "bitmap_equiv_bytes", "delta_ratio", "full_resyncs")}
+        cells.append(cell)
+        print(json.dumps({"config": "fleet-ramp", **cell}), flush=True)
+    print(json.dumps({
+        "config": "fleet-ramp-summary",
+        "runs_per_client": runs_per_client, "seed": seed,
+        "cells": cells,
+    }), flush=True)
+    return cells
+
+
 def measure_deep(n_lanes=1024, limit=10_000_000, seconds=30.0):
     """BASELINE-config-3-shaped end-to-end number (the same workload
     bench.py reports in its `deep` extras): mangle campaign on demo_spin
@@ -338,7 +370,7 @@ if __name__ == "__main__":
     faulthandler.dump_traceback_later(
         int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
     names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused", "devmut",
-                                             "lanes", "tenants"]
+                                             "lanes", "tenants", "fleet"]
     for n in names:
         if n == "deep":
             measure_deep()
@@ -350,6 +382,8 @@ if __name__ == "__main__":
             measure_lanes_ramp()
         elif n == "tenants":
             measure_tenants_ramp()
+        elif n == "fleet":
+            measure_fleet()
         else:
             measure(n, CONFIGS[n])
         faulthandler.cancel_dump_traceback_later()

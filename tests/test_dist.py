@@ -129,7 +129,14 @@ def test_fuzz_to_crash_single_client(tmp_path):
     assert server.stats.crashes >= 1, server.stats.testcases
     crashes = list((tmp_path / "crashes").iterdir())
     assert crashes, "no crash file saved"
-    assert any(p.name.startswith("crash-") for p in crashes)
+    # server-side crash files are named by the digest of the BYTES (one
+    # hex_digest source of truth, like outputs/): a hostile node cannot
+    # collide/overwrite another node's crash file with a chosen name
+    from wtf_tpu.utils.hashing import hex_digest
+
+    for p in crashes:
+        assert hex_digest(p.read_bytes()) == p.name, p.name
+    assert server.crash_names, "reported names still tracked"
     assert len(server.coverage) > 0
     # aggregate coverage persisted in the .cov format we also ingest
     from wtf_tpu.utils.covfiles import parse_cov_files

@@ -248,6 +248,35 @@ def summarize(path) -> dict:
             resilience["checkpoint_mean_seconds"] = round(
                 sum(checkpoint_secs) / len(checkpoint_secs), 4)
 
+    # fleet (distribution tier): streaming-delta wire savings, store
+    # dedup activity, crash bucket-dedup rate, elastic reshards.  None
+    # when the run produced no fleet signal.
+    fleet = None
+    delta_bytes = metrics.get("dist.cov_bytes_delta", 0) or 0
+    bitmap_bytes = metrics.get("dist.cov_bytes_bitmap", 0) or 0
+    fleet_signals = {
+        "delta_frames": metrics.get("fleet.delta_frames", 0) or 0,
+        "full_resyncs": metrics.get("fleet.full_resyncs", 0) or 0,
+        "cursor_resumes": metrics.get("fleet.cursor_resumes", 0) or 0,
+        "coverage_writes": metrics.get("fleet.coverage_writes", 0) or 0,
+        "store_puts": metrics.get("fleet.store_puts", 0) or 0,
+        "store_dedup_hits": metrics.get("fleet.store_dedup", 0) or 0,
+        "bucket_dedup_hits": metrics.get("fleet.bucket_dedup", 0) or 0,
+        "reshards": metrics.get("campaign.reshards", 0) or 0,
+    }
+    if any(fleet_signals.values()) or delta_bytes:
+        fleet = dict(fleet_signals)
+        fleet["cov_bytes_delta"] = delta_bytes
+        fleet["cov_bytes_bitmap_equiv"] = bitmap_bytes
+        fleet["cov_bytes_saved"] = max(bitmap_bytes - delta_bytes, 0)
+        fleet["delta_ratio"] = (round(bitmap_bytes / delta_bytes, 1)
+                                if delta_bytes else None)
+        crashes_seen = ((metrics.get("campaign.crashes", 0) or 0)
+                        or fleet["bucket_dedup_hits"])
+        fleet["bucket_dedup_rate"] = (
+            round(fleet["bucket_dedup_hits"] / crashes_seen, 4)
+            if crashes_seen else None)
+
     testcases = metrics.get("campaign.testcases", 0) or 0
     fallbacks = metrics.get("runner.fallbacks_by_opclass", {})
     if not isinstance(fallbacks, dict):
@@ -307,6 +336,7 @@ def summarize(path) -> dict:
         "triage": triage,
         "tenants": tenants,
         "resilience": resilience,
+        "fleet": fleet,
         "errors": errors,
     }
 
@@ -428,6 +458,19 @@ def _print_human(s: dict) -> None:
               f"reconnects={res['reconnects']} "
               f"reclaimed={res['reclaimed_testcases']} "
               f"resumes={res['resumes']} drains={res['drains']}{ckpt}")
+    flt = s.get("fleet")
+    if flt:
+        ratio = (f"{flt['delta_ratio']}x"
+                 if flt.get("delta_ratio") is not None else "n/a")
+        print(f"fleet: delta-frames={flt['delta_frames']} "
+              f"cov-bytes saved={flt['cov_bytes_saved']} "
+              f"(delta {ratio} smaller, "
+              f"full-resyncs={flt['full_resyncs']}, "
+              f"cursor-resumes={flt['cursor_resumes']}) "
+              f"store puts={flt['store_puts']} "
+              f"dedup={flt['store_dedup_hits']} "
+              f"bucket-dedup={flt['bucket_dedup_hits']} "
+              f"reshards={flt['reshards']}")
     for err in s["errors"]:
         print(f"error: {err['kind']}: {err['detail']}")
 

@@ -247,6 +247,14 @@ class TpuBackend(Backend):
         cov = np.asarray(jax.device_get(self.runner.machine.cov[lane]))
         return set(self.runner.cache.rips_of_bits(cov))
 
+    def lane_cov_words(self, lane: int) -> np.ndarray:
+        """This lane's raw coverage bitmap words (device-indexed pull,
+        no address decode) — what the WTF3 delta path ships instead of
+        the decoded RIP set: bit i is decode-cache entry i, so the
+        fleet cursor's XOR against the last-acked aggregate is the whole
+        delta extraction (wtf_tpu/fleet/delta.BitmapDeltaCursor)."""
+        return np.asarray(jax.device_get(self.runner.machine.cov[lane]))
+
     def lane_result_detail(self, lane: int) -> str:
         return self.runner.lane_errors.get(lane, "")
 

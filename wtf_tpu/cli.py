@@ -153,6 +153,12 @@ def build_parser() -> argparse.ArgumentParser:
                            "not-yet-upgraded master: raw downstream "
                            "frames, no BYE, and therefore no reconnect "
                            "(rolling-upgrade escape hatch)")
+    fuzz.add_argument("--no-cov-delta", action="store_true",
+                      help="ship whole coverage sets per result (the "
+                           "pre-fleet WTF2 wire) instead of streaming "
+                           "coverage deltas against the master's ack "
+                           "cursor — the escape hatch for masters that "
+                           "predate WTF3 (--wire-v1 implies it)")
     _add_backend_tuning(fuzz, mesh=True)
 
     master = sub.add_parser("master", help="master node (serves testcases)")
@@ -168,6 +174,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "silent this long (presumed dead); 0 = off. "
                              "Reclaim-on-disconnect is always on; SIGTERM "
                              "drains gracefully either way")
+    master.add_argument("--store", type=Path, default=None, metavar="DIR",
+                        help="content-addressed corpus/crash store root "
+                             "(wtf_tpu/fleet/store): digest-named blobs "
+                             "in sharded fanout dirs with a manifest "
+                             "journal; outputs//crashes/ become flat "
+                             "views of it")
 
     snap = sub.add_parser(
         "snapshot", help="convert snapshots between formats")
@@ -217,6 +229,10 @@ def build_parser() -> argparse.ArgumentParser:
                       help="resume from a checkpoint dir: coverage, crash "
                            "set, corpus, RNG and devmut streams restore "
                            "bit-identically to the uninterrupted run")
+    camp.add_argument("--store", type=Path, default=None, metavar="DIR",
+                      help="content-addressed corpus/crash store root "
+                           "(wtf_tpu/fleet/store); outputs//crashes/ "
+                           "become flat views of it")
     camp.add_argument("--coordinator", default=None,
                       help="jax.distributed coordinator address for a"
                            " multi-host launch (host:port)")
@@ -258,6 +274,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="events.jsonl with tenant-tagged records + "
                             "sched-round/sched-preempt/sched-complete; "
                             "summarize with tools/telemetry_report.py")
+    sched.add_argument("--store", type=Path, default=None, metavar="DIR",
+                       help="content-addressed store root; each job "
+                            "gets its own tenant-<name> namespace "
+                            "(wtf_tpu/fleet/store)")
     _add_backend_tuning(sched, mesh=True)
 
     triage = sub.add_parser(
@@ -330,6 +350,58 @@ def build_parser() -> argparse.ArgumentParser:
     tvb.add_argument("--limit", type=int, default=0)
     tvb.add_argument("--lanes", type=int, default=64)
     _add_backend_tuning(tvb, mesh=True)
+
+    fleet = sub.add_parser(
+        "fleet", help="fleet tier (wtf_tpu/fleet): elastic resharding, "
+                      "the corpus/crash store, the thousand-client soak")
+    fsub = fleet.add_subparsers(dest="fleet_cmd", required=True)
+
+    fre = fsub.add_parser(
+        "reshard", help="resume a checkpointed campaign under a "
+                        "DIFFERENT --mesh-devices placement: coverage, "
+                        "crash buckets, corpus and devmut streams are "
+                        "placement-free, so the resumed run is "
+                        "bit-identical to never having moved")
+    _add_target_selection(fre)
+    _add_paths(fre)
+    fre.add_argument("--checkpoint", type=Path, required=True,
+                     metavar="DIR",
+                     help="the campaign checkpoint dir (PR-8 format) to "
+                          "re-place; a running campaign writes one at "
+                          "every batch boundary under --checkpoint-every")
+    fre.add_argument("--runs", type=int, required=True,
+                     help="total testcase budget to finish (the budget "
+                          "is not part of the checkpoint)")
+    fre.add_argument("--limit", type=int, default=0)
+    fre.add_argument("--lanes", type=int, default=64,
+                     help="must equal the checkpoint's lane count (the "
+                          "lane count is the stream identity; "
+                          "lanes-per-chip is what resharding changes)")
+    fre.add_argument("--mutator",
+                     choices=("auto", "byte", "mangle", "tlv",
+                              "devmangle"), default="auto")
+    fre.add_argument("--max_len", type=int, default=1024 * 1024)
+    fre.add_argument("--seed", type=int, default=0)
+    _add_backend_tuning(fre, mesh=True)
+
+    fso = fsub.add_parser(
+        "soak", help="the chaos soak (wtf_tpu/fleet/soak): N simulated "
+                     "clients over the real wire with injected "
+                     "resets/reclaims/frame drops; zero-lost + "
+                     "serial-replay-parity + delta-ratio assertions")
+    fso.add_argument("--clients", type=int, default=256)
+    fso.add_argument("--runs-per-client", type=int, default=60)
+    fso.add_argument("--seed", type=int, default=0xF1EE7)
+    fso.add_argument("--threads", type=int, default=16)
+    fso.add_argument("--min-ratio", type=float, default=10.0)
+
+    ffs = fsub.add_parser(
+        "fsck", help="verify (and with --repair, recover) a fleet "
+                     "store: quarantine torn blobs, drop journal "
+                     "entries whose blob vanished, journal orphans")
+    ffs.add_argument("--store", type=Path, required=True, metavar="DIR")
+    ffs.add_argument("--namespace", default="default")
+    ffs.add_argument("--repair", action="store_true")
 
     lint = sub.add_parser(
         "lint", help="graph-invariant static analysis of the hot-path "
@@ -510,6 +582,7 @@ def cmd_fuzz(args) -> int:
                        seed=args.seed, lanes=args.lanes,
                        mesh_devices=args.mesh_devices,
                        max_retry_secs=args.max_retry_secs,
+                       cov_delta=not args.no_cov_delta,
                        paths=_paths_from(args))
     target = _lookup_target(args)
     with _telemetry_for(args) as (registry, events):
@@ -522,13 +595,15 @@ def cmd_fuzz(args) -> int:
                                registry=registry, events=events,
                                print_stats=True,
                                max_retry_secs=opts.max_retry_secs,
-                               wire_v1=args.wire_v1)
+                               wire_v1=args.wire_v1,
+                               cov_delta=opts.cov_delta)
         else:
             node = Client(backend, target, opts.address,
                           registry=registry, events=events,
                           print_stats=True,
                           max_retry_secs=opts.max_retry_secs,
-                          wire_v1=args.wire_v1)
+                          wire_v1=args.wire_v1,
+                          cov_delta=opts.cov_delta)
         served = node.run()
     print(f"node served {served} testcases")
     return 0
@@ -542,11 +617,18 @@ def cmd_master(args) -> int:
                          runs=args.runs, max_len=args.max_len,
                          seed=args.seed,
                          reclaim_timeout=args.reclaim_timeout,
-                         paths=_paths_from(args))
+                         store=args.store, paths=_paths_from(args))
     target = _lookup_target(args)
     with _telemetry_for(args) as (registry, events):
         rng = random.Random(opts.seed or None)
-        corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
+        store = None
+        if opts.store:
+            from wtf_tpu.fleet.store import FleetStore
+
+            store = FleetStore(opts.store, registry=registry,
+                               events=events)
+        corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng,
+                        store=store)
         coverage_path = (Path(opts.paths.target) / "coverage.cov"
                          if opts.paths.target else None)
         server = Server(opts.address,
@@ -556,7 +638,8 @@ def cmd_master(args) -> int:
                         max_len=opts.max_len, print_stats=True,
                         coverage_path=coverage_path,
                         registry=registry, events=events,
-                        reclaim_timeout=opts.reclaim_timeout)
+                        reclaim_timeout=opts.reclaim_timeout,
+                        store=store)
         stats = server.run()
     print(server.stats.line(len(server.coverage), len(corpus), 0))
     if server.drained:
@@ -579,7 +662,7 @@ def cmd_campaign(args) -> int:
                            stop_on_crash=args.stop_on_crash,
                            checkpoint_every=args.checkpoint_every,
                            checkpoint_dir=args.checkpoint_dir,
-                           resume=args.resume,
+                           resume=args.resume, store=args.store,
                            paths=_paths_from(args))
     # checkpoint dir defaulting: explicit flag > the resume dir (a
     # resumed campaign keeps checkpointing in place) > <target>/checkpoint
@@ -612,6 +695,12 @@ def cmd_campaign(args) -> int:
                                  tuning=_backend_tuning_kwargs(args))
         target.init(backend)
         rng = random.Random(opts.seed or None)
+        store = None
+        if opts.store:
+            from wtf_tpu.fleet.store import FleetStore
+
+            store = FleetStore(opts.store, registry=registry,
+                               events=events)
         # minset (--runs=0) fills its corpus from ONE merged scan below
         # (no double read of inputs/); fuzz mode loads inputs and
         # persists coverage-increasing finds into outputs/
@@ -620,8 +709,10 @@ def cmd_campaign(args) -> int:
         elif opts.paths.inputs and Path(opts.paths.inputs).is_dir():
             corpus = Corpus.load_dir(opts.paths.inputs, rng=rng,
                                      outputs_dir=opts.paths.outputs)
+            corpus.store = store
         else:
-            corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
+            corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng,
+                            store=store)
         from wtf_tpu.fuzz.mutator import create_mutator
 
         mutator = (_mutator_for(target, rng, opts.max_len)
@@ -631,7 +722,8 @@ def cmd_campaign(args) -> int:
                         corpus, crashes_dir=opts.paths.crashes,
                         registry=registry, events=events,
                         checkpoint_dir=ckpt_dir,
-                        checkpoint_every=opts.checkpoint_every)
+                        checkpoint_every=opts.checkpoint_every,
+                        store=store)
         if opts.resume:
             from wtf_tpu.resume import load_campaign, restore_campaign
 
@@ -669,11 +761,17 @@ def cmd_sched(args) -> int:
     tuning = _backend_tuning_kwargs(args)
     mesh_devices = tuning.pop("mesh_devices", None)
     with _telemetry_for(args) as (registry, events):
+        store = None
+        if args.store:
+            from wtf_tpu.fleet.store import FleetStore
+
+            store = FleetStore(args.store, registry=registry,
+                               events=events)
         sched = Scheduler(jobs, n_lanes=args.lanes, workdir=args.workdir,
                           limit=args.limit, quantum=args.quantum,
                           mesh_devices=mesh_devices,
                           registry=registry, events=events,
-                          backend_tuning=tuning)
+                          backend_tuning=tuning, store=store)
         summary = sched.run(max_rounds=args.max_rounds)
     crashes = 0
     for name, s in summary.items():
@@ -858,6 +956,107 @@ def _triage_vbreak(opts, backend, target, registry, events) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """`wtf-tpu fleet {reshard,soak,fsck}` — the fleet tier
+    (wtf_tpu/fleet)."""
+    if args.fleet_cmd == "soak":
+        import tempfile
+
+        from wtf_tpu.fleet.soak import run_soak
+
+        logging.getLogger("wtf_tpu").setLevel(logging.ERROR)
+        with tempfile.TemporaryDirectory() as tmp:
+            report = run_soak(tmp, clients=args.clients,
+                              runs_per_client=args.runs_per_client,
+                              seed=args.seed, threads=args.threads,
+                              min_ratio=args.min_ratio)
+        import json
+
+        print(json.dumps(report, indent=1))
+        print(f"fleet soak PASS ({report['clients']} clients, zero "
+              f"lost, delta {report['delta_ratio']}x smaller)")
+        return 0
+    if args.fleet_cmd == "fsck":
+        from wtf_tpu.fleet.store import FleetStore
+
+        store = FleetStore(args.store, namespace=args.namespace)
+        report = store.verify(repair=args.repair)
+        print(f"fsck {args.store}/{args.namespace}: "
+              f"{report['ok']}/{report['blobs']} blobs ok, "
+              f"{len(report['torn'])} torn, "
+              f"{len(report['missing'])} missing, "
+              f"{len(report['orphans'])} orphan(s)"
+              + (" — repaired" if args.repair else ""))
+        broken = report["torn"] or report["missing"] or report["orphans"]
+        return 0 if (args.repair or not broken) else 1
+    return _fleet_reshard(args)
+
+
+def _fleet_reshard(args) -> int:
+    """Resume a checkpointed campaign under a different --mesh-devices
+    placement (wtf_tpu/fleet/elastic).  Checkpoints are placement-free
+    and devmut streams are shard-count invariant, so the resumed run is
+    bit-identical to one that never moved."""
+    import random as _random
+
+    from wtf_tpu.config import FleetOptions
+    from wtf_tpu.fleet.elastic import describe_checkpoint, run_elastic, \
+        validate_placement
+    from wtf_tpu.fuzz.corpus import Corpus
+    from wtf_tpu.fuzz.loop import FuzzLoop
+    from wtf_tpu.fuzz.mutator import create_mutator
+    from wtf_tpu.resume import CheckpointError, load_campaign
+
+    opts = FleetOptions(name=args.name, checkpoint=args.checkpoint,
+                        mesh_devices=args.mesh_devices, runs=args.runs,
+                        limit=args.limit, lanes=args.lanes,
+                        mutator=args.mutator, max_len=args.max_len,
+                        seed=args.seed, paths=_paths_from(args))
+    try:
+        info = describe_checkpoint(opts.checkpoint)
+        state, _ = load_campaign(opts.checkpoint)
+        validate_placement(state, opts.mesh_devices)
+    except (CheckpointError, ValueError) as e:
+        print(f"reshard: {e}")
+        return 1
+    cfg = info["config"]
+    print(f"reshard: checkpoint at batch {info['batches']} "
+          f"({cfg.get('lanes')} lanes on "
+          f"{cfg.get('mesh_devices') or 1} device(s), "
+          f"{info['corpus']} corpus entries) -> "
+          f"{opts.mesh_devices or 1} device(s)")
+    target = _lookup_target(args)
+    with _telemetry_for(args) as (registry, events):
+        tuning = _backend_tuning_kwargs(args)
+        tuning.pop("mesh_devices", None)
+
+        def build_loop(mesh_devices):
+            build = dict(tuning)
+            if mesh_devices is not None:
+                build["mesh_devices"] = mesh_devices
+            backend = _build_backend(target, "tpu", opts.paths,
+                                     opts.limit, opts.lanes,
+                                     registry=registry, events=events,
+                                     tuning=build)
+            target.init(backend)
+            rng = _random.Random(opts.seed or None)
+            corpus = Corpus(outputs_dir=opts.paths.outputs, rng=rng)
+            mutator = (_mutator_for(target, rng, opts.max_len)
+                       if opts.mutator == "auto"
+                       else create_mutator(opts.mutator, rng,
+                                           opts.max_len))
+            return FuzzLoop(backend, target, mutator, corpus,
+                            crashes_dir=opts.paths.crashes,
+                            registry=registry, events=events,
+                            checkpoint_dir=opts.checkpoint,
+                            checkpoint_every=1)
+        loop = run_elastic(build_loop, opts.runs, opts.checkpoint,
+                           start_devices=opts.mesh_devices, resume=True,
+                           print_stats=True)
+        print(loop.stats.line(len(loop.corpus), loop._coverage()))
+        return 0 if loop.stats.crashes == 0 else 2
+
+
 def cmd_lint(args) -> int:
     """`wtf-tpu lint`: the graph-invariant linter (wtf_tpu/analysis),
     telemetry-wired like every other subcommand — findings land in the
@@ -929,6 +1128,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "sched": cmd_sched,
         "snapshot": cmd_snapshot,
         "triage": cmd_triage,
+        "fleet": cmd_fleet,
         "lint": cmd_lint,
     }[args.subcommand]
     return driver(args)

@@ -71,6 +71,11 @@ class FuzzOptions:
     # for this long before the node gives up (0 = reference behavior:
     # first loss ends the node)
     max_retry_secs: float = 60.0
+    # streaming coverage deltas (wtf_tpu/fleet/delta, WTF3): results
+    # carry only newly-set coverage bits against the master's ack
+    # cursor.  Needs a delta-capable master; `fuzz --no-cov-delta` is
+    # the rolling-upgrade escape hatch (--wire-v1 implies it)
+    cov_delta: bool = True
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
 
 
@@ -87,6 +92,10 @@ class MasterOptions:
     # long (presumed dead: wedged chip, half-open TCP); 0 = off —
     # drop-detection reclaim is always on regardless
     reclaim_timeout: float = 0.0
+    # content-addressed corpus/crash store root (wtf_tpu/fleet/store);
+    # None keeps the flat outputs//crashes/ directories as the system
+    # of record instead of as views
+    store: Optional[Path] = None
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
 
 
@@ -140,4 +149,23 @@ class CampaignOptions:
     checkpoint_every: int = 0
     checkpoint_dir: Optional[Path] = None
     resume: Optional[Path] = None
+    # content-addressed corpus/crash store root (wtf_tpu/fleet/store)
+    store: Optional[Path] = None
+    paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
+
+
+@dataclasses.dataclass
+class FleetOptions:
+    """`wtf-tpu fleet reshard` (wtf_tpu/fleet/elastic): resume a
+    checkpointed campaign under a different device placement."""
+
+    name: str = ""
+    checkpoint: Optional[Path] = None
+    mesh_devices: Optional[int] = None
+    runs: int = 0
+    limit: int = 0
+    lanes: int = 64
+    mutator: str = "auto"
+    max_len: int = 1024 * 1024
+    seed: int = 0
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)

@@ -25,7 +25,17 @@ import random
 import time
 from typing import List, Optional, Set, Tuple
 
-from wtf_tpu.core.results import TestcaseResult, Timedout
+from wtf_tpu.core.results import (
+    Crash, OverlayFull, TestcaseResult, Timedout,
+)
+
+
+def _coverage_revoked(result) -> bool:
+    """Results whose coverage must not be reported (client.cc:122-125;
+    overlay-full lanes ran on truncated memory): the delta path must
+    also not piggyback unacked-bit repair on them — the master credits
+    any new addresses on a frame to THAT frame's testcase."""
+    return isinstance(result, (Timedout, OverlayFull))
 from wtf_tpu.dist import wire
 from wtf_tpu.fuzz.loop import CampaignStats
 from wtf_tpu import telemetry
@@ -54,7 +64,7 @@ class MasterLink:
                  max_retry_secs: float = 0.0,
                  registry: Optional[Registry] = None, events=None,
                  rng: Optional[random.Random] = None,
-                 tagged: bool = True):
+                 tagged: bool = True, cursor=None):
         self.address = address
         self.n_slots = n_slots
         self.max_retry_secs = max_retry_secs
@@ -68,6 +78,12 @@ class MasterLink:
         # finished master).  The rolling-upgrade escape hatch
         # (`fuzz --wire-v1`).
         self.tagged = tagged
+        # streaming-coverage cursor (wtf_tpu/fleet/delta.DeltaCursor):
+        # upgrades the hello to WTF3 and every upstream result to a
+        # TAG_COVDELTA frame; the link drives the cursor's handshake
+        # (TAG_CURSOR after (re)connect) and implicit acks (each WORK
+        # frame proves the master accounted everything sent before it)
+        self.cursor = cursor if tagged else None
         self.sock = None
         self._bye = False
 
@@ -77,8 +93,12 @@ class MasterLink:
         self._drop_socket()  # never strand a previous fd
         sock = wire.dial(self.address, retry_for=retry_for)
         try:
-            wire.send_msg(sock, wire.encode_hello(self.n_slots,
-                                                  tagged=self.tagged))
+            if self.cursor is not None:
+                hello = wire.encode_hello_delta(self.n_slots,
+                                                self.cursor.client_id)
+            else:
+                hello = wire.encode_hello(self.n_slots, tagged=self.tagged)
+            wire.send_msg(sock, hello)
         except OSError:
             # hello lost with the connection (master died between accept
             # and read — the crash-loop shape): close, don't leak the fd
@@ -163,7 +183,31 @@ class MasterLink:
                 self._bye = True
                 self._drop_socket()
                 return None
+            if tag == wire.TAG_CURSOR and self.cursor is not None:
+                # the master names the ack cursor it holds for us:
+                # resume sparse deltas or fall back to a bitmap resync.
+                # A truncated frame (desynced master) is a connection
+                # problem, not a node-fatal one — same error surface as
+                # the master's own frame decode.
+                import struct as _struct
+
+                try:
+                    self.cursor.on_cursor(*wire.decode_cursor(payload))
+                except (ValueError, IndexError, _struct.error):
+                    self._drop_socket()
+                    if not self._reconnect():
+                        return None
+                continue
+            if self.cursor is not None:
+                # a WORK frame is the implicit ack: the master only
+                # serves after accounting our previous result frame
+                self.cursor.on_ack()
             return payload
+
+    def send_delta(self, body: bytes) -> bool:
+        """Send one TAG_COVDELTA frame (delta-result body, or a batch
+        frame of them on mux links)."""
+        return self.send(bytes((wire.TAG_COVDELTA,)) + body)
 
     def send(self, body: bytes) -> bool:
         """Best-effort result send.  On failure the socket drops and the
@@ -199,15 +243,25 @@ class _NodeTelemetry:
 
 
 def run_testcase_and_restore(backend, target, data: bytes,
-                             ) -> Tuple[TestcaseResult, Set[int]]:
-    """The canonical sequence (client.cc:88-180)."""
+                             want_bucket: bool = False):
+    """The canonical sequence (client.cc:88-180).  `want_bucket` adds
+    the PR-9 triage bucket of a crash as a third return — it must be
+    computed BEFORE the restore rolls the faulting state back, which is
+    why it lives inside this sequence."""
     target.insert_testcase(backend, data)
     result = backend.run()
     if isinstance(result, Timedout):
         backend.revoke_last_new_coverage()  # client.cc:122-125
     coverage = backend.last_new_coverage()
+    bucket = ""
+    if want_bucket and isinstance(result, Crash):
+        from wtf_tpu.triage.bucket import bucket_of
+
+        bucket = bucket_of(backend, 0, result)
     target.restore()
     backend.restore()
+    if want_bucket:
+        return result, coverage, bucket
     return result, coverage
 
 
@@ -223,35 +277,57 @@ class Client(_NodeTelemetry):
                  stats_every: float = 10.0, print_stats: bool = False,
                  max_retry_secs: float = 0.0,
                  retry_rng: Optional[random.Random] = None,
-                 wire_v1: bool = False):
+                 wire_v1: bool = False, cov_delta: bool = False,
+                 client_id: Optional[bytes] = None):
         self.backend = backend
         self.target = target
         self.address = address
         self.max_retry_secs = max_retry_secs
         self.retry_rng = retry_rng
         self.wire_v1 = wire_v1
+        # cov_delta: speak WTF3 — results carry only newly-set coverage
+        # bits against the master's ack cursor (wtf_tpu/fleet/delta)
+        # instead of the whole coverage set.  Needs a delta-capable
+        # master; --no-cov-delta is the rolling-upgrade escape hatch.
+        self.cov_delta = cov_delta and not wire_v1
+        self.client_id = client_id
         self.runs = 0
         self._init_telemetry(backend, registry, events, stats_every,
                              print_stats)
 
     def run(self, max_runs: int = 0) -> int:
         """Serve until the master says BYE / stays gone (or max_runs)."""
+        from wtf_tpu.fleet.delta import AddressDeltaCursor
+
         self.target.init(self.backend)
+        cursor = (AddressDeltaCursor(self.client_id, self.registry)
+                  if self.cov_delta else None)
         link = MasterLink(self.address, 1, self.max_retry_secs,
                           registry=self.registry, events=self.events,
-                          rng=self.retry_rng, tagged=not self.wire_v1)
+                          rng=self.retry_rng, tagged=not self.wire_v1,
+                          cursor=cursor)
         link.connect(retry_for=10.0)
         try:
             while max_runs == 0 or self.runs < max_runs:
                 testcase = link.recv_work()
                 if testcase is None:
                     break  # campaign over / master gone for good
-                result, coverage = run_testcase_and_restore(
-                    self.backend, self.target, testcase)
+                result, coverage, bucket = run_testcase_and_restore(
+                    self.backend, self.target, testcase, want_bucket=True)
                 self.stats.account(result)
                 # a lost result is fine: the master reclaimed this
                 # testcase with the socket and re-serves it elsewhere
-                link.send(wire.encode_result(testcase, coverage, result))
+                if cursor is not None:
+                    if _coverage_revoked(result):
+                        body = cursor.encode_empty(testcase, result,
+                                                   bucket=bucket)
+                    else:
+                        body = cursor.encode_result(
+                            testcase, result, coverage, bucket=bucket)
+                    link.send_delta(body)
+                else:
+                    link.send(wire.encode_result(testcase, coverage,
+                                                 result))
                 self.runs += 1
                 self._heartbeat()
         finally:
@@ -277,7 +353,7 @@ class BatchClient(_NodeTelemetry):
                  stats_every: float = 10.0, print_stats: bool = False,
                  max_retry_secs: float = 0.0,
                  retry_rng: Optional[random.Random] = None,
-                 wire_v1: bool = False):
+                 wire_v1: bool = False, cov_delta: bool = False):
         self.backend = backend
         self.target = target
         self.address = address
@@ -285,23 +361,48 @@ class BatchClient(_NodeTelemetry):
         self.max_retry_secs = max_retry_secs
         self.retry_rng = retry_rng
         self.wire_v1 = wire_v1
+        # WTF3 streaming deltas (wtf_tpu/fleet/delta).  On the mux link
+        # the cursor rides the backend's native `[words, 32]` bit space
+        # — delta extraction is one XOR against the last-acked aggregate
+        # and no per-lane address decode happens at all; on the
+        # 1-fd-per-lane shape each link keeps its own address cursor.
+        self.cov_delta = cov_delta and not wire_v1
         self.rounds = 0
         self.runs = 0
         self._init_telemetry(backend, registry, events, stats_every,
                              print_stats)
 
-    def _link(self, n_slots: int) -> MasterLink:
+    def _link(self, n_slots: int, cursor=None) -> MasterLink:
         return MasterLink(self.address, n_slots, self.max_retry_secs,
                           registry=self.registry, events=self.events,
-                          rng=self.retry_rng, tagged=not self.wire_v1)
+                          rng=self.retry_rng, tagged=not self.wire_v1,
+                          cursor=cursor)
+
+    def _lane_reportable(self, lane: int, result) -> bool:
+        """Does this lane have coverage worth shipping?  Timeout lanes
+        are revoked (client.cc:122-125) and no-new-coverage lanes have
+        nothing the master hasn't seen from this client."""
+        return (not isinstance(result, Timedout)
+                and self.backend.lane_found_new_coverage(lane))
+
+    def _bucket(self, lane: int, result) -> str:
+        if not isinstance(result, Crash):
+            return ""
+        from wtf_tpu.triage.bucket import bucket_of
+
+        return bucket_of(self.backend, lane, result)
 
     def run(self, max_rounds: int = 0) -> int:
         if self.mux:
             return self._run_mux(max_rounds)
+        from wtf_tpu.fleet.delta import AddressDeltaCursor
+
         self.target.init(self.backend)
         links: List[MasterLink] = []
         for _ in range(self.backend.n_lanes):
-            link = self._link(1)
+            cursor = (AddressDeltaCursor(registry=self.registry)
+                      if self.cov_delta else None)
+            link = self._link(1, cursor=cursor)
             link.connect(retry_for=10.0)
             links.append(link)
         try:
@@ -330,15 +431,27 @@ class BatchClient(_NodeTelemetry):
                 results = self.backend.run_batch(batch, self.target)
                 for lane, (link, data, result) in enumerate(
                         zip(links, batch, results)):
-                    coverage = self.backend.lane_coverage(lane)
-                    if isinstance(result, Timedout):
-                        coverage = set()  # revoked (client.cc:122-125)
-                    elif not self.backend.lane_found_new_coverage(lane):
-                        coverage = set()  # nothing new to report
+                    # the lane's whole coverage set decodes ONLY when
+                    # there is something new to report (the v2 path used
+                    # to pull it per lane unconditionally)
+                    coverage = (self.backend.lane_coverage(lane)
+                                if self._lane_reportable(lane, result)
+                                else set())
                     self.stats.account(result)
                     # lost sends abandon the result (master reclaims);
                     # the lane stays — its next recv_work reconnects
-                    link.send(wire.encode_result(data, coverage, result))
+                    if link.cursor is not None:
+                        bucket = self._bucket(lane, result)
+                        if _coverage_revoked(result):
+                            body = link.cursor.encode_empty(
+                                data, result, bucket=bucket)
+                        else:
+                            body = link.cursor.encode_result(
+                                data, result, coverage, bucket=bucket)
+                        link.send_delta(body)
+                    else:
+                        link.send(wire.encode_result(data, coverage,
+                                                     result))
                     self.runs += 1
                 self.target.restore()
                 self.backend.restore()
@@ -351,8 +464,12 @@ class BatchClient(_NodeTelemetry):
 
     def _run_mux(self, max_rounds: int = 0) -> int:
         """Multiplexed rounds: one batch frame in, one batch frame out."""
+        from wtf_tpu.fleet.delta import BitmapDeltaCursor
+
         self.target.init(self.backend)
-        link = self._link(self.backend.n_lanes)
+        cursor = (BitmapDeltaCursor(self.backend, registry=self.registry)
+                  if self.cov_delta else None)
+        link = self._link(self.backend.n_lanes, cursor=cursor)
         link.connect(retry_for=10.0)
         try:
             while max_rounds == 0 or self.rounds < max_rounds:
@@ -363,18 +480,24 @@ class BatchClient(_NodeTelemetry):
                 if not batch:
                     break
                 results = self.backend.run_batch(batch, self.target)
-                replies = []
-                for lane, (data, result) in enumerate(zip(batch, results)):
-                    coverage = self.backend.lane_coverage(lane)
-                    if isinstance(result, Timedout):
-                        coverage = set()  # revoked (client.cc:122-125)
-                    elif not self.backend.lane_found_new_coverage(lane):
-                        coverage = set()  # nothing new to report
-                    self.stats.account(result)
-                    replies.append(
-                        wire.encode_result(data, coverage, result))
-                    self.runs += 1
-                link.send(wire.encode_batch(replies))
+                if cursor is not None:
+                    replies = self._delta_replies(cursor, batch, results)
+                    for result in results:
+                        self.stats.account(result)
+                    self.runs += len(batch)
+                    link.send_delta(wire.encode_batch(replies))
+                else:
+                    replies = []
+                    for lane, (data, result) in enumerate(
+                            zip(batch, results)):
+                        coverage = (self.backend.lane_coverage(lane)
+                                    if self._lane_reportable(lane, result)
+                                    else set())
+                        self.stats.account(result)
+                        replies.append(
+                            wire.encode_result(data, coverage, result))
+                        self.runs += 1
+                    link.send(wire.encode_batch(replies))
                 self.target.restore()
                 self.backend.restore()
                 self.rounds += 1
@@ -382,3 +505,45 @@ class BatchClient(_NodeTelemetry):
         finally:
             link.close()
         return self.runs
+
+    def _delta_replies(self, cursor, batch, results) -> List[bytes]:
+        """One round's delta bodies: each reportable lane carries the
+        bits it is FIRST to claim against the acked aggregate (claim
+        chaining mirrors the device merge's prefix credit); bits no lane
+        of this round covers — coverage whose frame was lost with a
+        dropped connection — ride the first NON-revoked body, so the
+        link repairs loss by re-extraction, never by retransmission
+        bookkeeping.  Revoked results (timeouts, overlay-full) always
+        go out as empty bodies: the master credits a frame's addresses
+        to its testcase, and a hang must never earn corpus admission."""
+        import numpy as np
+
+        agg = np.asarray(self.backend.coverage_state()[0], np.uint32)
+        lane_words = {
+            lane: self.backend.lane_cov_words(lane)
+            for lane, result in enumerate(results)
+            if self._lane_reportable(lane, result)}
+        carried = np.zeros_like(agg)
+        for words in lane_words.values():
+            carried |= np.asarray(words, np.uint32)
+        stale = cursor.unacked(agg) & ~carried
+        carrier = next((lane for lane, result in enumerate(results)
+                        if not _coverage_revoked(result)), None)
+        claimed = np.zeros_like(agg)
+        replies = []
+        first = True
+        for lane, (data, result) in enumerate(zip(batch, results)):
+            bucket = self._bucket(lane, result)
+            if _coverage_revoked(result):
+                replies.append(cursor.encode_empty(data, result,
+                                                   bucket=bucket))
+                continue
+            words = lane_words.get(lane)
+            if lane == carrier and stale.any():
+                words = stale if words is None \
+                    else np.asarray(words, np.uint32) | stale
+            replies.append(cursor.encode_lane(
+                data, result, words, claimed, bucket=bucket,
+                first=first))
+            first = False
+        return replies

@@ -68,9 +68,11 @@ class _Conn:
     """Per-connection master state: slot count from the node's hello frame
     (1 = reference shape; >1 = lane-multiplexed batch frames), the
     testcases in flight on it, whether the node speaks tagged (v2)
-    frames, and when the in-flight batch was sent (reclaim timeout)."""
+    frames or coverage deltas (v3, with its client identity), and when
+    the in-flight batch was sent (reclaim timeout)."""
 
-    __slots__ = ("slots", "mux", "inflight", "tagged", "since")
+    __slots__ = ("slots", "mux", "inflight", "tagged", "since", "delta",
+                 "client_id")
 
     def __init__(self):
         self.slots = 1
@@ -78,6 +80,8 @@ class _Conn:
         self.inflight: List[bytes] = []
         self.tagged = False
         self.since = 0.0
+        self.delta = False
+        self.client_id: Optional[str] = None
 
 
 class Server:
@@ -97,6 +101,8 @@ class Server:
         events=None,
         reclaim_timeout: float = 0.0,
         drain_grace: float = 5.0,
+        store=None,
+        cursor_cap: int = 4096,
     ):
         self.address = address
         self.mutator = mutator
@@ -133,8 +139,36 @@ class Server:
         self.coverage: Set[int] = set()
         self.mutations = 0
         self.crash_names: Set[str] = set()
+        # crash dedup service: keyed by the PR-9 triage bucket when the
+        # node reports one (WTF3 frames), by sanitized name otherwise —
+        # only novel keys are persisted/announced
+        self.crash_buckets: Set[str] = set()
+        # content-addressed corpus/crash store (wtf_tpu/fleet/store);
+        # None keeps the flat-directory behavior
+        self.store = store
+        if store is not None:
+            corpus.store = store
         self._ovf_requeued: Set[str] = set()
         self._ever_served = False
+        # streaming-coverage ack cursors, keyed by client identity
+        # (wtf_tpu/fleet/delta.ServerCursor); persisted with the
+        # coverage file so a restarted master resumes them instead of
+        # forcing whole-bitmap resyncs.  `_restored` holds addresses
+        # implied by restored state: part of the persisted/served
+        # aggregate but NOT of the corpus-admission test, so the
+        # replayed outputs/ corpus still re-earns its entries.
+        self._cursors: Dict[str, object] = {}
+        # eviction bound: a cursor is a near-copy of the address table
+        # per client IDENTITY, and identities are fresh per node
+        # process/link — without a cap, restarts accumulate dead tables
+        # in memory and in the persisted coverage file forever.  LRU
+        # over the cap, never a cursor with a live connection; an
+        # evicted identity that comes back just pays one bitmap resync.
+        self.cursor_cap = cursor_cap
+        self._restored: Set[int] = set()
+        self._cov_dirty = False
+        self._last_persist = time.time()
+        self._load_coverage_state()
         self._listener: Optional[socket.socket] = None
         self._clients: Dict[socket.socket, _Conn] = {}
         self._sel: Optional[selectors.BaseSelector] = None
@@ -230,10 +264,12 @@ class Server:
     def handle_result(self, body: bytes) -> None:
         self._account_result(*wire.decode_result(body))
 
-    def _account_result(self, testcase, coverage, result) -> None:
+    def _account_result(self, testcase, coverage, result,
+                        bucket: str = "") -> None:
         new = coverage - self.coverage
         if new:
             self.coverage |= new
+            self._cov_dirty = self._cov_dirty or bool(new - self._restored)
             self.stats.last_cov = time.time()
             self.stats.new_coverage += 1  # same per-testcase semantics as
             self.mutator.on_new_coverage(testcase)  # FuzzLoop's counter
@@ -241,26 +277,7 @@ class Server:
             self.events.emit("new-coverage", new_addresses=len(new),
                              total=len(self.coverage), size=len(testcase))
         if self.stats.account(result):
-            if result.name:
-                # the name crossed the WIRE: whitelist-sanitize before
-                # using it as a filename (a hostile node must not steer
-                # the write path; NUL/control bytes would otherwise take
-                # down open() with ValueError, not OSError)
-                name = re.sub(r"[^A-Za-z0-9._-]", "_",
-                              result.name).lstrip(".")[:200] or "crash-unnamed"
-                self.events.emit("crash", name=name, size=len(testcase),
-                                 new=name not in self.crash_names)
-                self.crash_names.add(name)
-                if self.crashes_dir:
-                    try:
-                        # atomic (tmp+fsync+rename): a kill mid-save must
-                        # not leave a torn repro under crashes/
-                        atomic_write_bytes(self.crashes_dir / name,
-                                           testcase)
-                    except (OSError, ValueError) as e:
-                        log.warning("crash save failed for %r: %s", name, e)
-                        self.events.emit("error", kind="crash-save",
-                                         name=name, detail=str(e))
+            self._save_crash(testcase, result, bucket)
         elif isinstance(result, OverlayFull):
             # node resource limit, not a finding: requeue ONCE for an
             # honest re-run (ideally on a node with more overlay slots);
@@ -269,6 +286,46 @@ class Server:
             if digest not in self._ovf_requeued:
                 self._ovf_requeued.add(digest)
                 self.paths.append(testcase)
+
+    def _save_crash(self, testcase: bytes, result, bucket: str) -> None:
+        """Crash intake: dedup by triage bucket (reported by WTF3 nodes;
+        sanitized name otherwise), persist only novel keys, and name the
+        file from the digest of the BYTES — the one hex_digest source of
+        truth, same as the torn-corpus check — so a malicious or buggy
+        node can neither steer the write path nor collide/overwrite
+        another node's crash file with a chosen name."""
+        if not result.name:
+            return
+        # the name crossed the WIRE: whitelist-sanitize before any use
+        # (events, store journal) — never trusted as a filename anymore
+        name = re.sub(r"[^A-Za-z0-9._-]", "_",
+                      result.name).lstrip(".")[:200] or "crash-unnamed"
+        self.crash_names.add(name)
+        key = bucket or name
+        if key in self.crash_buckets:
+            # known bucket: counted in the stats, but neither persisted
+            # nor announced — the dedup half of the crash service
+            self.registry.counter("fleet.bucket_dedup").inc()
+            return
+        self.crash_buckets.add(key)
+        digest = hex_digest(testcase)
+        self.events.emit("crash", name=name, size=len(testcase),
+                         digest=digest, bucket=bucket or None, new=True)
+        try:
+            if self.store is not None:
+                self.store.put(testcase, kind="crash", name=name,
+                               bucket=bucket or None)
+                if self.crashes_dir:
+                    # flat digest-named view for operators/old tooling
+                    self.store.link_into(self.crashes_dir, digest)
+            elif self.crashes_dir:
+                # atomic (tmp+fsync+rename): a kill mid-save must not
+                # leave a torn repro under crashes/
+                atomic_write_bytes(self.crashes_dir / digest, testcase)
+        except (OSError, ValueError) as e:
+            log.warning("crash save failed for %r: %s", name, e)
+            self.events.emit("error", kind="crash-save",
+                             name=name, detail=str(e))
 
     # -- drain (SIGTERM) ---------------------------------------------------
     def request_drain(self) -> None:
@@ -351,6 +408,13 @@ class Server:
                     # ahead of any undrained initial corpus
                     self.paths.extendleft(reversed(injected))
                 self._maybe_print()
+                if now - self._last_persist >= self.stats_every:
+                    # interval persistence (dirty-flagged: no-op when the
+                    # aggregate and cursors are unchanged) — what lets a
+                    # restarted master resume client ack cursors
+                    self._last_persist = now
+                    self._evict_cursors()
+                    self._write_coverage()
         finally:
             restore_sigterm()
             for sock, conn in list(self._clients.items()):
@@ -367,7 +431,7 @@ class Server:
             self._sel = None
             self._listener.close()
             self._listener = None
-            self._write_coverage()
+            self._write_coverage(final=True)
         return self.stats
 
     def _install_sigterm(self):
@@ -404,24 +468,67 @@ class Server:
                             now - conn.since, self.reclaim_timeout)
                 self._drop(sock, reason="timeout")
 
-    def _write_coverage(self) -> None:
-        """Persist the aggregate coverage in the .cov JSON shape
-        (reference coverage.cov aggregate, README.md:166; integer
-        addresses per the gen_coveragefile_* format) so campaigns
-        resume/compare offline.  Best-effort: runs in the reactor's
-        finally block and must not mask an in-flight exception."""
-        if self.coverage_path is None:
+    def _load_coverage_state(self) -> None:
+        """Resume the delta ack cursors (and the aggregate they imply)
+        from a prior master's coverage file: a reconnecting WTF3 node
+        whose cursor still matches resumes sparse deltas instead of a
+        whole-bitmap resync.  Restored addresses land in `_restored`
+        (served/persisted, but corpus admission still re-earns through
+        the outputs/ replay).  Best-effort: an unreadable or pre-fleet
+        file simply starts fresh."""
+        if self.coverage_path is None or not self.coverage_path.exists():
             return
         import json
 
+        from wtf_tpu.fleet.delta import ServerCursor
+
+        try:
+            doc = json.loads(self.coverage_path.read_text(encoding="utf-8"))
+            cursors = doc.get("cursors", {})
+            for cid, state in cursors.items():
+                self._cursors[cid] = ServerCursor.from_state(state)
+            self._restored = set(int(a) for a in doc.get("addresses", []))
+        except (ValueError, KeyError, OSError) as e:
+            log.warning("coverage state unusable (%s); starting fresh", e)
+            self._cursors = {}
+            self._restored = set()
+            return
+        if self._cursors:
+            self.registry.counter("fleet.cursor_resumes").inc(
+                len(self._cursors))
+            self.events.emit("cursor-resume", clients=len(self._cursors),
+                             addresses=len(self._restored))
+
+    def _write_coverage(self, final: bool = False) -> None:
+        """Persist the aggregate coverage in the .cov JSON shape
+        (reference coverage.cov aggregate, README.md:166; integer
+        addresses per the gen_coveragefile_* format) plus the per-client
+        delta ack cursors, so campaigns resume/compare offline and a
+        restarted master resumes cursors.  Dirty-flagged: an interval
+        where nothing changed costs no write.  Best-effort: also runs in
+        the reactor's finally block and must not mask an in-flight
+        exception."""
+        if self.coverage_path is None:
+            return
+        if not self._cov_dirty and not (final
+                                        and not self.coverage_path.exists()):
+            return
+        import json
+
+        doc = {
+            "name": "aggregate",
+            "addresses": sorted(self.coverage | self._restored),
+        }
+        if self._cursors:
+            doc["cursors"] = {cid: cur.state()
+                              for cid, cur in self._cursors.items()}
         try:
             # atomic (utils/atomicio): a kill mid-write must leave the
             # previous coverage file intact, never a torn JSON — this is
             # the file a resumed/offline analysis reads
-            atomic_write_text(self.coverage_path, json.dumps({
-                "name": "aggregate",
-                "addresses": sorted(self.coverage),
-            }))
+            atomic_write_text(self.coverage_path, json.dumps(doc))
+            self._cov_dirty = False
+            self.registry.counter("fleet.coverage_writes").inc()
         except OSError as e:
             log.warning("coverage.cov write failed: %s", e)
             self.events.emit("error", kind="coverage-write",
@@ -482,6 +589,20 @@ class Server:
             conn.slots = max(1, n_slots)
             conn.mux = conn.slots > 1
             conn.tagged = wire.hello_is_tagged(body)
+            client_id = wire.hello_client_id(body)
+            if client_id is not None:
+                conn.delta = True
+                conn.client_id = client_id.hex()
+                cursor = self._cursor_for(conn)
+                try:
+                    # name the ack cursor we hold for this identity so a
+                    # reconnecting node resumes sparse deltas (or learns
+                    # it must resync) BEFORE any work flows
+                    wire.send_msg(sock, wire.encode_cursor(
+                        *cursor.summary()))
+                except OSError:
+                    self._drop(sock)
+                    return
             if not conn.inflight:
                 self._set_writable(sock, True)  # greeted: open for work
             return
@@ -489,11 +610,13 @@ class Server:
             # decode EVERYTHING before accounting ANYTHING: a malformed
             # tail in a mux batch must not leave already-counted results
             # that then get requeued (double execution, stat skew)
-            if conn.mux:
-                decoded = [wire.decode_result(b)
-                           for b in wire.decode_batch(body)]
+            if conn.delta:
+                items = self._decode_delta_frame(conn, body)
+            elif conn.mux:
+                items = [wire.decode_result(b) + ("",)
+                         for b in wire.decode_batch(body)]
             else:
-                decoded = [wire.decode_result(body)]
+                items = [wire.decode_result(body) + ("",)]
         except (ValueError, IndexError, struct.error) as e:
             # desynced/malformed result frame: a broken node must not
             # take the master down — drop it, requeue its in-flight work.
@@ -506,10 +629,65 @@ class Server:
                              detail=repr(e), requeued=len(conn.inflight))
             self._drop(sock)
             return
-        for item in decoded:
+        for item in items:
             self._account_result(*item)
         conn.inflight = []
         self._set_writable(sock, True)
+
+    def _cursor_for(self, conn: _Conn):
+        from wtf_tpu.fleet.delta import ServerCursor
+
+        cursor = self._cursors.get(conn.client_id)
+        if cursor is None:
+            cursor = self._cursors[conn.client_id] = ServerCursor()
+        cursor.touch()
+        return cursor
+
+    def _evict_cursors(self) -> None:
+        """Drop the least-recently-active cursors over `cursor_cap`,
+        skipping identities with a live connection.  An evicted node
+        that reconnects sees a fresh cursor and performs one
+        whole-bitmap resync — slower, never wrong."""
+        over = len(self._cursors) - self.cursor_cap
+        if over <= 0:
+            return
+        live = {conn.client_id for conn in self._clients.values()
+                if conn.client_id}
+        victims = sorted(
+            (cid for cid in self._cursors if cid not in live),
+            key=lambda cid: self._cursors[cid].last_seen)[:over]
+        for cid in victims:
+            del self._cursors[cid]
+        if victims:
+            self._cov_dirty = True
+            self.registry.counter("fleet.cursor_evictions").inc(
+                len(victims))
+
+    def _decode_delta_frame(self, conn: _Conn, body: bytes) -> List[tuple]:
+        """One WTF3 upstream frame -> [(testcase, addresses, result,
+        bucket)].  Applying a delta mutates only the CURSOR (idempotent
+        set-union state); master accounting happens strictly after the
+        whole frame decoded+mapped, so a malformed tail still accounts
+        nothing and the re-served testcases re-send their bits."""
+        if not body or body[0] != wire.TAG_COVDELTA:
+            raise ValueError("untagged frame on a delta connection")
+        payload = body[1:]
+        bodies = wire.decode_batch(payload) if conn.mux else [payload]
+        decoded = [wire.decode_result_delta(b) for b in bodies]
+        cursor = self._cursor_for(conn)
+        items = []
+        changed = False
+        for testcase, delta, result, bucket in decoded:
+            if delta.full:
+                self.registry.counter("fleet.full_resyncs").inc()
+            changed = changed or delta.full or bool(delta.pairs) \
+                or bool(delta.addrs)
+            items.append((testcase, cursor.apply(delta), result, bucket))
+        self.registry.counter("fleet.delta_frames").inc(len(bodies))
+        self.registry.counter("fleet.delta_bytes").inc(len(body))
+        if changed:
+            self._cov_dirty = True
+        return items
 
     def _drop(self, sock: socket.socket, bye: bool = False,
               reason: str = "drop") -> None:

@@ -156,6 +156,7 @@ def recv_msg(sock: socket.socket) -> Optional[bytes]:
 
 HELLO_MAGIC = b"WTFH"    # v1: server->client frames are raw payloads
 HELLO2_MAGIC = b"WTF2"   # v2: server->client frames carry a 1-byte tag
+HELLO3_MAGIC = b"WTF3"   # v3: v2 + streaming coverage deltas (fleet tier)
 
 # v2 downstream frame tags.  v1 has no in-band way to distinguish "the
 # campaign is over, don't come back" from "the master died" — the raw
@@ -165,8 +166,25 @@ HELLO2_MAGIC = b"WTF2"   # v2: server->client frames carry a 1-byte tag
 #   TAG_BYE   orderly end (budget done / drain): do NOT reconnect
 # v1 clients (and any reference-shaped client) keep getting untagged
 # frames and learn about shutdown the way they always did: a close.
+#
+# v3 (WTF3 hello: 16-byte client identity appended) additionally opts
+# the connection into streaming coverage deltas (wtf_tpu/fleet/delta):
+#   TAG_CURSOR    master->node, right after the hello: the ack cursor
+#                 the master holds for this client identity, so a
+#                 reconnecting node resumes sparse deltas instead of
+#                 resending its whole bitmap
+#   TAG_COVDELTA  node->master: every post-hello upstream frame carries
+#                 this tag + a delta-result body (or a batch frame of
+#                 delta-result bodies on mux links) — newly-set coverage
+#                 bits only, as sparse word-index+mask pairs over the
+#                 client's own bit space, with bit->address table
+#                 registrations riding alongside
 TAG_WORK = 0
 TAG_BYE = 1
+TAG_CURSOR = 2
+TAG_COVDELTA = 3
+
+CLIENT_ID_LEN = 16
 
 
 def encode_hello(n_slots: int, tagged: bool = False) -> bytes:
@@ -174,16 +192,38 @@ def encode_hello(n_slots: int, tagged: bool = False) -> bytes:
         + struct.pack("<I", n_slots)
 
 
+def encode_hello_delta(n_slots: int, client_id: bytes) -> bytes:
+    """The WTF3 hello: tagged frames + streaming coverage deltas.  The
+    client identity survives reconnects (and master restarts, via the
+    persisted cursor state) — it is what per-client ack cursors key on."""
+    if len(client_id) != CLIENT_ID_LEN:
+        raise ValueError(f"client id must be {CLIENT_ID_LEN} bytes")
+    return HELLO3_MAGIC + struct.pack("<I", n_slots) + client_id
+
+
 def decode_hello(body: bytes) -> Optional[int]:
-    """n_slots when `body` is a hello frame (either version), else None."""
+    """n_slots when `body` is a hello frame (any version), else None."""
     if len(body) == 8 and body[:4] in (HELLO_MAGIC, HELLO2_MAGIC):
+        return struct.unpack_from("<I", body, 4)[0]
+    if len(body) == 8 + CLIENT_ID_LEN and body[:4] == HELLO3_MAGIC:
         return struct.unpack_from("<I", body, 4)[0]
     return None
 
 
 def hello_is_tagged(body: bytes) -> bool:
     """True when a hello frame opted into tagged downstream frames."""
-    return len(body) == 8 and body[:4] == HELLO2_MAGIC
+    return (len(body) == 8 and body[:4] == HELLO2_MAGIC) \
+        or hello_is_delta(body)
+
+
+def hello_is_delta(body: bytes) -> bool:
+    """True when a hello frame opted into streaming coverage deltas."""
+    return len(body) == 8 + CLIENT_ID_LEN and body[:4] == HELLO3_MAGIC
+
+
+def hello_client_id(body: bytes) -> Optional[bytes]:
+    """The 16-byte client identity of a WTF3 hello, else None."""
+    return body[8:] if hello_is_delta(body) else None
 
 
 def send_work(sock: socket.socket, body: bytes, tagged: bool) -> None:
@@ -194,6 +234,26 @@ def send_work(sock: socket.socket, body: bytes, tagged: bool) -> None:
 def send_bye(sock: socket.socket) -> None:
     """Orderly-shutdown frame (tagged connections only)."""
     send_msg(sock, bytes((TAG_BYE,)))
+
+
+def encode_cursor(n_table: int, digest: bytes) -> bytes:
+    """Master->node ack-cursor frame body: how many bit->address table
+    entries the master holds for this client identity plus an 8-byte
+    digest of the whole acked state (table + acked bitmap).  The node
+    compares against its own state: match -> resume sparse deltas;
+    mismatch (fresh master, lost cursor) -> whole-bitmap resync."""
+    if len(digest) != 8:
+        raise ValueError("cursor digest must be 8 bytes")
+    return bytes((TAG_CURSOR,)) + struct.pack("<I", n_table) + digest
+
+
+def decode_cursor(payload: bytes) -> Tuple[int, bytes]:
+    """(n_table, digest8) of a TAG_CURSOR frame payload."""
+    (n_table,) = struct.unpack_from("<I", payload, 0)
+    digest = payload[4:12]
+    if len(digest) != 8:
+        raise ValueError("short cursor frame")
+    return n_table, digest
 
 
 def recv_tagged(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
@@ -281,3 +341,113 @@ def decode_result(body: bytes) -> Tuple[bytes, Set[int], TestcaseResult]:
     else:
         result = Crash(name or None)
     return testcase, coverage, result
+
+
+# ---------------------------------------------------------------------------
+# delta-result message body (WTF3 / TAG_COVDELTA upstream frames)
+# ---------------------------------------------------------------------------
+# Where a v1/v2 result ships the lane's WHOLE coverage set (n_cov u64
+# addresses — O(covered blocks) per new-coverage result), a delta result
+# ships only the bits newly set since the master's last ack:
+#
+#   u8  flags            bit 0: full resync (master must drop any prior
+#                        cursor state for this client before applying)
+#   u32 testcase_len | testcase
+#   u32 table_base       first bit index of the address registrations
+#   u32 n_addrs | n_addrs * u64        bit->address table entries for
+#                                      indices [table_base, table_base+n)
+#   u32 n_pairs | n_pairs * (u32 word_index, u32 mask)   the delta bits,
+#                                      sparse over the client's bit space
+#   u8  kind | u16 name_len | name     as in the v1/v2 result body
+#   u16 bucket_len | bucket            PR-9 triage bucket (crash dedup
+#                                      service key; empty when unknown)
+#
+# Bit indices are CLIENT-local (decode order); the table registrations
+# are what make them meaningful master-side.  The cursor state machines
+# that produce/consume these live in wtf_tpu/fleet/delta.py.
+
+FLAG_FULL = 1
+
+
+class DeltaFrame:
+    """Decoded coverage-delta payload of one result."""
+
+    __slots__ = ("full", "table_base", "addrs", "pairs")
+
+    def __init__(self, full: bool, table_base: int, addrs, pairs):
+        self.full = full
+        self.table_base = table_base
+        self.addrs = list(addrs)
+        self.pairs = list(pairs)
+
+    def cov_bytes(self) -> int:
+        """Wire bytes of the coverage sections (table_base + n_addrs +
+        n_pairs u32 headers, 8 per address, 8 per pair) — the part the
+        delta scheme shrinks; testcase/result bytes are common to both
+        protocols."""
+        return 12 + 8 * len(self.addrs) + 8 * len(self.pairs)
+
+
+def encode_result_delta(testcase: bytes, result: TestcaseResult,
+                        delta: DeltaFrame, bucket: str = "") -> bytes:
+    kind = _KIND[type(result)]
+    name = (result.name or "").encode() if isinstance(result, Crash) else b""
+    bucket_b = bucket.encode()
+    pairs_flat = []
+    for word, mask in delta.pairs:
+        pairs_flat.append(word)
+        pairs_flat.append(mask)
+    parts = [
+        struct.pack("<B", FLAG_FULL if delta.full else 0),
+        struct.pack("<I", len(testcase)), testcase,
+        struct.pack("<II", delta.table_base, len(delta.addrs)),
+        struct.pack(f"<{len(delta.addrs)}Q", *delta.addrs),
+        struct.pack("<I", len(delta.pairs)),
+        struct.pack(f"<{len(pairs_flat)}I", *pairs_flat),
+        struct.pack("<B", kind),
+        struct.pack("<H", len(name)), name,
+        struct.pack("<H", len(bucket_b)), bucket_b,
+    ]
+    return b"".join(parts)
+
+
+def decode_result_delta(body: bytes):
+    """-> (testcase, DeltaFrame, result, bucket)."""
+    off = 0
+
+    def take(fmt):
+        nonlocal off
+        size = struct.calcsize(fmt)
+        vals = struct.unpack_from(fmt, body, off)
+        off += size
+        return vals
+
+    (flags,) = take("<B")
+    (tc_len,) = take("<I")
+    testcase = body[off:off + tc_len]
+    off += tc_len
+    table_base, n_addrs = take("<II")
+    addrs = list(take(f"<{n_addrs}Q")) if n_addrs else []
+    (n_pairs,) = take("<I")
+    flat = take(f"<{2 * n_pairs}I") if n_pairs else ()
+    pairs = [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
+    (kind,) = take("<B")
+    (name_len,) = take("<H")
+    name = body[off:off + name_len].decode()
+    off += name_len
+    (bucket_len,) = take("<H")
+    bucket = body[off:off + bucket_len].decode()
+    off += bucket_len
+    result: TestcaseResult
+    if kind == 0:
+        result = Ok()
+    elif kind == 1:
+        result = Timedout()
+    elif kind == 2:
+        result = Cr3Change()
+    elif kind == 4:
+        result = OverlayFull()
+    else:
+        result = Crash(name or None)
+    delta = DeltaFrame(bool(flags & FLAG_FULL), table_base, addrs, pairs)
+    return testcase, delta, result, bucket

@@ -1,0 +1,33 @@
+"""Fleet tier: the distribution layer that scales the master/node plane
+(ROADMAP item 4) from a handful of ad-hoc clients to a serving system.
+
+Three legs, each usable on its own:
+
+  delta.py    streaming coverage deltas over the WTF2 wire (WTF3 hello /
+              TAG_COVDELTA frames): results carry only newly-set
+              coverage bits as sparse word+mask pairs against the
+              master's per-client ack cursor, with whole-bitmap resync
+              on first contact and cursor loss
+  store.py    append-only content-addressed corpus/crash store: Blake-
+              digested blobs in sharded fanout dirs, dedup on write, a
+              manifest journal, crash intake deduped by the PR-9 triage
+              bucket, per-tenant namespaces
+  elastic.py  elastic campaigns: checkpoint a running campaign at a
+              batch boundary (PR-8 format) and resume it bit-identically
+              under a different --mesh-devices placement
+  soak.py     the proof harness: hundreds-to-1000 simulated clients over
+              the real wire protocol with injected resets/reclaims,
+              asserting zero lost testcases and exact aggregate-coverage
+              agreement with a serial replay
+"""
+
+from wtf_tpu.fleet.delta import (
+    AddressDeltaCursor, BitmapDeltaCursor, DeltaCursor, ServerCursor,
+    cursor_digest,
+)
+from wtf_tpu.fleet.store import FleetStore
+
+__all__ = [
+    "AddressDeltaCursor", "BitmapDeltaCursor", "DeltaCursor",
+    "FleetStore", "ServerCursor", "cursor_digest",
+]

@@ -1,0 +1,114 @@
+"""Elastic campaigns: reshard a live campaign onto a different device
+count without losing a bit.
+
+Why this is nearly free (the Concordia posture, PAPERS.md): PR-8
+checkpoints are *placement-free* — coverage bitmaps, decode cache,
+devmut slab views and RNG state none of which mention a mesh — and PR-7
+mesh programs are byte-stable per device with shard-count-invariant
+devmut streams.  So "autoscale a running campaign from 1 chip to 8"
+decomposes into machinery that already exists:
+
+  1. the in-master policy hook (`FuzzLoop.reshard_policy`) fires at a
+     batch boundary: the loop checkpoints (PR-8 format) and returns
+     with `reshard_to` set
+  2. the driver rebuilds the campaign against the new `--mesh-devices`
+     count and restores the checkpoint — bit-identical resume is the
+     PR-8 parity bar, which never pinned a placement
+  3. the campaign continues; coverage/crash-bucket/corpus state ends
+     byte-identical to the uninterrupted run
+
+`run_elastic` is the in-process driver (the soak/test harness and the
+scheduler tier use it); `wtf-tpu fleet reshard` is the operator-facing
+one-step version: validate a checkpoint, re-place, resume.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+class ScheduledReshard:
+    """Reshard policy from a fixed plan {batch_index: device_count} —
+    the deterministic driver the parity tests and the soak use.  A
+    production autoscaler is the same shape: any callable(loop) ->
+    Optional[int] consulted at batch boundaries."""
+
+    def __init__(self, plan: Dict[int, int]):
+        self.plan = dict(plan)
+        self.fired = []
+
+    def __call__(self, loop) -> Optional[int]:
+        want = self.plan.pop(loop.batches_done, None)
+        if want is not None:
+            self.fired.append((loop.batches_done, want))
+        return want
+
+
+def placement_of(loop) -> Optional[int]:
+    """The device count a loop currently runs on (None = single)."""
+    mesh = getattr(loop.backend, "mesh", None)
+    return getattr(mesh, "size", None)
+
+
+def validate_placement(state: dict, mesh_devices: Optional[int]) -> None:
+    """A checkpoint re-places onto `mesh_devices` iff the TOTAL lane
+    count divides: lanes are the stream identity (devmut seeds key on
+    lane index), lanes-per-chip is the free variable."""
+    lanes = state.get("config", {}).get("lanes")
+    if mesh_devices and lanes and lanes % mesh_devices:
+        raise ValueError(
+            f"cannot reshard: checkpoint has {lanes} lanes, not divisible "
+            f"by --mesh-devices {mesh_devices} (the lane count is the "
+            f"stream identity and must stay fixed; lanes-per-chip is what "
+            f"resharding changes)")
+
+
+def run_elastic(build_loop: Callable, runs: int, checkpoint_dir,
+                policy=None, start_devices: Optional[int] = None,
+                resume: bool = False, print_stats: bool = False):
+    """Drive one campaign across placements until its run budget is
+    spent.  `build_loop(mesh_devices)` must return a FRESH FuzzLoop
+    (backend initialized, target init, seeds loaded) for that placement;
+    everything that matters restores from the checkpoint.  Returns the
+    final loop (stats, corpus, coverage all live on it)."""
+    from wtf_tpu.resume import load_campaign, restore_campaign
+
+    checkpoint_dir = Path(checkpoint_dir)
+    devices = start_devices
+    restoring = resume
+    loop = None
+    while True:
+        loop = build_loop(devices)
+        loop.checkpoint_dir = checkpoint_dir
+        loop.reshard_policy = policy
+        if restoring:
+            state, _ = load_campaign(checkpoint_dir)
+            validate_placement(state, devices)
+            batch = restore_campaign(loop, state, checkpoint_dir)
+            log.info("resharded onto %s device(s) at batch %d",
+                     devices or 1, batch)
+        loop.fuzz(runs, print_stats=print_stats)
+        if loop.reshard_to is None:
+            return loop
+        devices = loop.reshard_to
+        restoring = True
+
+
+def describe_checkpoint(directory) -> dict:
+    """Operator summary of a checkpoint dir (the `fleet reshard`
+    preflight): config, progress, corpus size — raises CheckpointError
+    on a torn/unusable pair like any resume would."""
+    from wtf_tpu.resume import load_campaign
+
+    state, fell_back = load_campaign(directory)
+    return {
+        "config": state.get("config", {}),
+        "batches": state.get("batches", 0),
+        "corpus": len(state.get("corpus_manifest", [])),
+        "crash_buckets": len(state.get("crash_buckets", [])),
+        "fell_back": fell_back,
+    }

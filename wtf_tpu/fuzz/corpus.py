@@ -17,11 +17,17 @@ from wtf_tpu.utils.hashing import hex_digest
 
 class Corpus:
     def __init__(self, outputs_dir: Optional[Path] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None, store=None):
         self.outputs_dir = Path(outputs_dir) if outputs_dir else None
         if self.outputs_dir:
             self.outputs_dir.mkdir(parents=True, exist_ok=True)
         self.rng = rng or random.Random()
+        # content-addressed store (wtf_tpu/fleet/store.FleetStore): when
+        # attached, the store is the system of record and the flat
+        # outputs/ dir becomes a hardlink VIEW of it — same digest-named
+        # files for the seed replay scan and minset pruning, but writes
+        # land once, journaled, in the sharded blob tree
+        self.store = store
         self._items: List[bytes] = []
         self._digests = set()
         self.bytes_total = 0
@@ -37,7 +43,11 @@ class Corpus:
         self._digests.add(digest)
         self._items.append(data)
         self.bytes_total += len(data)
-        if self.outputs_dir:
+        if self.store is not None:
+            self.store.put(data, kind="corpus")
+            if self.outputs_dir:
+                self.store.link_into(self.outputs_dir, digest)
+        elif self.outputs_dir:
             # atomic: a campaign killed mid-save must not leave a torn
             # outputs/ entry for the restarted master to replay (the
             # file IS the persistence the resume path relies on)

@@ -145,6 +145,7 @@ class FuzzLoop:
         events=None,
         checkpoint_dir: Optional[Path] = None,
         checkpoint_every: int = 0,
+        store=None,
     ):
         self.backend = backend
         self.target = target
@@ -189,6 +190,19 @@ class FuzzLoop:
         self.checkpoint_dir = Path(checkpoint_dir) if checkpoint_dir else None
         self.checkpoint_every = checkpoint_every
         self.batches_done = 0
+        # content-addressed corpus/crash store (wtf_tpu/fleet/store):
+        # when attached, finds and crashes are journaled there and the
+        # flat outputs//crashes/ dirs become hardlink views
+        self.store = store
+        if store is not None and getattr(corpus, "store", None) is None:
+            corpus.store = store
+        # elastic-campaign policy hook (wtf_tpu/fleet/elastic): a
+        # callable(loop) -> Optional[int] consulted at every batch
+        # boundary; returning a device count checkpoints the campaign
+        # (PR-8 format) and hands control back to the driver, which
+        # re-places it onto that many devices and resumes bit-identically
+        self.reshard_policy = None
+        self.reshard_to: Optional[int] = None
         if self.checkpoint_every and not hasattr(backend, "coverage_state"):
             # fail at construction, not at the first cadence hit deep
             # into a campaign (the checkpoint needs the batched backend's
@@ -319,8 +333,18 @@ class FuzzLoop:
                 # atomic (tmp+fsync+rename): a kill mid-save must not
                 # leave a torn repro, and a full disk must not abort the
                 # campaign from inside the harvest loop (same contract
-                # as the dist master's crash save)
-                atomic_write_bytes(self.crashes_dir / name, data)
+                # as the dist master's crash save).  With a store the
+                # blob is journaled content-addressed (bucket-deduped)
+                # and crashes/<name> becomes a view of it — names stay
+                # reference-shaped for the single-process driver.
+                if self.store is not None:
+                    digest, _ = self.store.put(data, kind="crash",
+                                               name=name, bucket=bucket)
+                    if self.store.has(digest):
+                        self.store.link_into(self.crashes_dir, digest,
+                                             name=name)
+                else:
+                    atomic_write_bytes(self.crashes_dir / name, data)
             except OSError as e:
                 import logging
 
@@ -376,14 +400,42 @@ class FuzzLoop:
              stop_on_crash: bool = False) -> CampaignStats:
         """Run until `runs` testcases executed (0 = forever; the CLI maps
         --runs=0 to `minset` instead, matching the reference)."""
+        self.reshard_to = None
         while runs == 0 or self.stats.testcases < runs:
             found = self.run_one_batch()
             self.batches_done += 1
             self._maybe_checkpoint()
+            if self._maybe_reshard():
+                break
             self._heartbeat(print_stats)
             if stop_on_crash and found:
                 break
         return self.stats
+
+    def _maybe_reshard(self) -> bool:
+        """The elastic-campaign policy hook (wtf_tpu/fleet/elastic): at
+        each batch boundary the policy may name a new device count; the
+        loop then checkpoints (PR-8 format — placement-free) and stops,
+        leaving `reshard_to` for the driver to rebuild against.  True
+        when a reshard was requested."""
+        if self.reshard_policy is None:
+            return False
+        want = self.reshard_policy(self)
+        if want is None:
+            return False
+        if self.checkpoint_dir is None:
+            raise ValueError("resharding needs a checkpoint_dir")
+        from wtf_tpu.resume import save_campaign
+
+        self.reshard_to = int(want)
+        # count BEFORE the save: the checkpoint's counter state carries
+        # the reshard tally across placements (telemetry continuity)
+        self.registry.counter("campaign.reshards").inc()
+        self.events.emit("reshard", batch=self.batches_done,
+                         devices=self.reshard_to,
+                         testcases=self.stats.testcases)
+        save_campaign(self, self.checkpoint_dir)
+        return True
 
     def _maybe_checkpoint(self) -> None:
         """--checkpoint-every cadence: persist the resumable state at the

@@ -29,6 +29,21 @@ plus per-type payload fields.  The well-known types:
                 (kind, detail + per-kind fields)
   run-end       final registry dump at campaign end (metrics)
 
+The fault-tolerance tier adds retry/reconnect/reclaim/drain/
+checkpoint/resume records (wtf_tpu/resume, dist hardening) and the
+fleet tier adds:
+
+  store-put     a blob entered the content-addressed store
+                (store, kind, digest, size, bucket)
+  cursor-resume a restarted master resumed persisted delta ack
+                cursors (clients, addresses)
+  reshard       elastic placement change requested at a batch boundary
+                (batch, devices, testcases); the campaign checkpoints
+                and the driver re-places it
+
+`crash` records from the delta-speaking master additionally carry
+(digest, bucket) — files are digest-named and bucket-deduped there.
+
 Call sites hold a sink unconditionally: `NullEventLog` swallows
 everything, so `self.events.emit(...)` never needs a None check on a hot
 path.

@@ -114,7 +114,8 @@ class TenantRuntime:
                  crashes_dir: Optional[Path] = None,
                  checkpoint_dir: Optional[Path] = None,
                  checkpoint_every: int = 0,
-                 registry: Optional[Registry] = None, events=None):
+                 registry: Optional[Registry] = None, events=None,
+                 store=None):
         self.spec = spec
         self.name = spec.name
         self.target = spec.target
@@ -127,7 +128,12 @@ class TenantRuntime:
         self.registry = registry if registry is not None else Registry()
         self.events = events if events is not None else telemetry.NULL
         self.rng = random.Random(seed or None)
-        self.corpus = Corpus(rng=self.rng)
+        # per-tenant store namespace (wtf_tpu/fleet/store): a root
+        # FleetStore hands each tenant its own `tenant-<name>` corpus +
+        # crash space — shared fanout layout, zero shared state
+        self.store = (store.namespace(f"tenant-{spec.name}")
+                      if store is not None else None)
+        self.corpus = Corpus(rng=self.rng, store=self.store)
         self.crashes_dir = Path(crashes_dir) if crashes_dir else None
         if self.crashes_dir:
             self.crashes_dir.mkdir(parents=True, exist_ok=True)
@@ -221,7 +227,14 @@ class MultiTenantLoop:
             from wtf_tpu.utils.atomicio import atomic_write_bytes
 
             try:
-                atomic_write_bytes(rt.crashes_dir / name, data)
+                if rt.store is not None:
+                    digest, _ = rt.store.put(data, kind="crash",
+                                             name=name, bucket=bucket)
+                    if rt.store.has(digest):
+                        rt.store.link_into(rt.crashes_dir, digest,
+                                           name=name)
+                else:
+                    atomic_write_bytes(rt.crashes_dir / name, data)
             except OSError as e:
                 import logging
 

@@ -121,7 +121,7 @@ class Scheduler:
                  mesh_devices: Optional[int] = None,
                  registry: Optional[Registry] = None, events=None,
                  backend_tuning: Optional[dict] = None,
-                 stats_every: float = 10.0):
+                 stats_every: float = 10.0, store=None):
         if not jobs:
             raise ValueError("scheduler needs at least one job")
         for job in jobs:
@@ -143,6 +143,9 @@ class Scheduler:
             None, registry, events)
         self.backend_tuning = dict(backend_tuning or {})
         self.stats_every = stats_every
+        # root content-addressed store (wtf_tpu/fleet/store): each job
+        # gets its own tenant-<name> namespace carved out at placement
+        self.store = store
         self._snapshots: Dict[str, object] = {}  # target name -> Snapshot
         # live placement carried across rounds: when _place() returns
         # the same job set, the backend/loop are reused instead of a
@@ -213,7 +216,8 @@ class Scheduler:
                 crashes_dir=jobdir / "crashes",
                 checkpoint_dir=jobdir / "checkpoint",
                 checkpoint_every=job.checkpoint_every,
-                registry=self.registry, events=self.events)
+                registry=self.registry, events=self.events,
+                store=self.store)
             rt.seed_corpus(job.inputs)
             runtimes.append(rt)
         loop = MultiTenantLoop(backend, runtimes, registry=self.registry,
