@@ -7,9 +7,10 @@
 PY ?= python
 
 .PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke \
-	triage-smoke tenancy-smoke fleet-smoke
+	triage-smoke tenancy-smoke fleet-smoke fused-smoke
 
-verify: test lint chaos-smoke triage-smoke tenancy-smoke fleet-smoke
+verify: test lint chaos-smoke triage-smoke tenancy-smoke fleet-smoke \
+	fused-smoke
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -62,6 +63,13 @@ tenancy-smoke:
 # exchange, store fsck clean
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.fleet_smoke
+
+# fused-step + megachunk smoke (wtf_tpu/testing/fused_smoke): demo_tlv
+# occupancy >= 0.95 through the widened Pallas kernel (in-kernel page
+# walk + memory operands, interpret mode, small lanes) and a megachunk
+# window campaign bit-identical to the batch-at-a-time device loop
+fused-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.fused_smoke
 
 # deterministic fault-tolerance soak (wtf_tpu/testing/chaos_smoke):
 # seeded fault schedule over the real socket + checkpoint seams —

@@ -128,12 +128,39 @@ def test_budget_rule_fires_on_improvement_too():
 
 
 def test_checked_in_budget_matches_perf_record():
-    """analysis/budgets.json pins the step ladder at the PERF.md round-8
-    math: 168 surviving data-dependent kernels (81/59/28)."""
+    """analysis/budgets.json pins the step ladder at the PERF.md
+    round-16 math: 166 surviving data-dependent kernels (79/59/28 —
+    round 8's 168 minus the CPUID row gather and one x87 stack
+    gather)."""
     budget = load_budgets()["xla_step"]
-    assert budget["total"] == 168
+    assert budget["total"] == 166
     assert (budget["gather"], budget["dynamic-slice"],
-            budget["dynamic-update-slice"]) == (81, 59, 28)
+            budget["dynamic-update-slice"]) == (79, 59, 28)
+    # the tenant ladder is the SAME program over a stacked image table
+    assert load_budgets()["tenant_chunk"]["total"] == 166
+
+
+def test_rebaseline_is_a_ratchet():
+    """--rebaseline refuses to record a budget INCREASE without
+    --allow-regression (ISSUE 14): decrements re-pin freely, increments
+    raise naming every offending entry, and allow_regression=True
+    records them consciously."""
+    from wtf_tpu.analysis import apply_rebaseline
+
+    old = {"xla_step": {"total": 166, "gather": 79},
+           "mesh_chunk": {"total": 1}}
+    # a decrease (and a brand-new entry) merge freely
+    merged = apply_rebaseline(old, {"xla_step": {"total": 150},
+                                    "new_entry": {"total": 9}})
+    assert merged["xla_step"]["total"] == 150
+    assert merged["new_entry"]["total"] == 9
+    # an increase is refused, naming the entry and both totals
+    with pytest.raises(ValueError, match="xla_step: 166 -> 170"):
+        apply_rebaseline(old, {"xla_step": {"total": 170}})
+    # ... unless consciously allowed
+    merged = apply_rebaseline(old, {"xla_step": {"total": 170}},
+                              allow_regression=True)
+    assert merged["xla_step"]["total"] == 170
 
 
 # ---------------------------------------------------------------------------

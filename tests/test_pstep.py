@@ -19,7 +19,9 @@ import pytest
 
 from tests.emurunner import DATA_BASE, build_guest, run_emu
 from wtf_tpu.core.results import StatusCode
-from wtf_tpu.interp.machine import CTR_FUSED, CTR_INSTR
+from wtf_tpu.interp.machine import (
+    CTR_FUSED, CTR_INSTR, CTR_PARK_MEM, CTR_PARK_SUBSET,
+)
 from wtf_tpu.interp.runner import Runner
 from wtf_tpu.snapshot.loader import Snapshot
 
@@ -66,10 +68,12 @@ def _assert_ladders_equal(r0, s0, r1, s1, check_mem=False):
         a = np.asarray(getattr(r0.machine, field))
         b = np.asarray(getattr(r1.machine, field))
         if field == "ctr":
-            # CTR_FUSED legitimately differs (that's the point); every
-            # other device counter must agree exactly
-            a = np.delete(a, CTR_FUSED, axis=1)
-            b = np.delete(b, CTR_FUSED, axis=1)
+            # the fused-only counters (kernel occupancy + park split)
+            # legitimately differ (that's the point); every other device
+            # counter must agree exactly
+            fused_only = [CTR_FUSED, CTR_PARK_SUBSET, CTR_PARK_MEM]
+            a = np.delete(a, fused_only, axis=1)
+            b = np.delete(b, fused_only, axis=1)
         assert np.array_equal(a, b), f"{field} diverged under fused ladder"
     if check_mem:
         v0, v1 = r0.view(), r1.view()
@@ -231,24 +235,77 @@ def test_fused_kernel_timeout_exact_vs_chunk():
 
 
 @pytest.mark.parametrize("seed", range(3))
+def test_fused_mem_grids_match_xla_and_oracle(seed):
+    """The widened-subset acceptance grid: programs made of MEMORY-
+    OPERAND forms — loads/stores through the in-kernel page walk and
+    delta overlay, mem-dst ALU/SHIFT/UNARY read-modify-writes, widening
+    and 2/3-operand mul, PUSH/POP/CALL/RET through the stack — execute
+    ENTIRELY in-kernel: bit-exact vs the XLA ladder (state, dirty
+    memory) and the EmuCpu oracle, at 100% occupancy."""
+    rng = random.Random(0x3E30 + seed)
+    body = []
+    for _ in range(28):
+        disp = rng.randrange(0, 0xE00) & ~7
+        width, reg = rng.choice(
+            (("qword", "rcx"), ("dword", "ecx"), ("word", "cx"),
+             ("byte", "cl")))
+        body.append(rng.choice([
+            f"mov [rbx + {disp}], {reg}",
+            f"mov {reg}, [rbx + {disp}]",
+            f"mov {width} ptr [rbx + {disp}], {rng.getrandbits(7)}",
+            f"add [rbx + {disp}], {reg}",
+            f"xor rax, [rbx + {disp}]",
+            f"cmp [rbx + {disp}], {reg}",
+            f"movzx r10, {width.replace('qword', 'word')} ptr "
+            f"[rbx + {disp}]" if width != "qword" else
+            f"mov r10, [rbx + {disp}]",
+            f"shl {width} ptr [rbx + {disp}], {rng.randrange(1, 7)}",
+            f"neg {width} ptr [rbx + {disp}]",
+            f"inc qword ptr [rbx + {disp}]",
+            "shl rax, 3",
+            f"ror rdx, {rng.randrange(1, 63)}",
+            "shld rax, rdx, 11",
+            "imul rdx, rax, 3",
+            "mul rcx",
+            "imul r9, rdx",
+            f"setc byte ptr [rbx + {disp}]",
+            f"cmovnz r10, qword ptr [rbx + {disp}]",
+            "push rax\npop rsi",
+            f"push qword ptr [rbx + {disp}]\npop r11",
+            "push 0x1234\npop r10",
+            "call 1f\njmp 2f\n1: add rax, 7\nret\n2:",
+        ]))
+    asm = (f"mov rbx, {DATA_BASE}\nmov rcx, 0x1122334455667788\n"
+           f"mov r14, 5\n3:\n" + "\n".join(body)
+           + "\ndec r14\njnz 3b\nint3")
+    data = {DATA_BASE: bytes(0x1000)}
+    emu = run_emu(asm, data=data)
+    (r0, s0), (r1, s1) = _run_pair(asm, data=data)
+    assert all(StatusCode(int(x)) == StatusCode.CRASH for x in s1)
+    _assert_ladders_equal(r0, s0, r1, s1, check_mem=True)
+    assert int(np.asarray(r1.machine.icount)[0]) == emu.icount
+    g = np.asarray(r1.machine.gpr)
+    assert [int(v) for v in g[0]] == list(emu.gpr)
+    fused, instr = _occupancy(r1)
+    assert fused == instr, (fused, instr)  # memory forms are hot now
+
+
+@pytest.mark.parametrize("seed", range(3))
 def test_fused_park_resume_seam_randomized(seed):
-    """The acceptance seam: programs interleaving hot code with NON-hot
-    instructions (memory operands, push/pop, shifts, widening mul,
-    strings, bswap) park mid-chunk and resume on the XLA path — final
-    state including dirty memory is identical to the XLA-only ladder, and
-    the fused/instruction counters partition exactly."""
+    """The acceptance seam: programs interleaving hot code (now
+    including memory operands and stack ops) with genuinely NON-hot
+    instructions (bswap, xchg, popcnt, bt, cqo, lahf) park mid-chunk and
+    resume on the XLA path — final state including dirty memory is
+    identical to the XLA-only ladder, and the fused/instruction counters
+    partition exactly."""
     rng = random.Random(0x5EA9 + seed)
     cold_pool = [
-        f"mov [rbx + {rng.randrange(0, 0xE00)}], rcx",
-        f"add rax, [rbx + {rng.randrange(0, 0xE00)}]",
-        "shl rax, 3",
-        f"ror rdx, {rng.randrange(1, 63)}",
-        "imul rdx, rax, 3",
-        "mul rcx",
-        "push rax",
-        "pop rsi",
         "bswap rax",
         "xchg rax, rdx",
+        "popcnt r10, rax",
+        "bt rax, 3",
+        "cqo",
+        "lahf",
     ]
     body = []
     for _ in range(24):
@@ -257,6 +314,9 @@ def test_fused_park_resume_seam_randomized(seed):
         else:
             body.append(rng.choice([
                 f"add rax, {rng.randrange(1, 1 << 20)}",
+                f"mov [rbx + {rng.randrange(0, 0xE00)}], rcx",
+                f"add rax, [rbx + {rng.randrange(0, 0xE00)}]",
+                "push rax", "pop rsi",
                 "inc r9", "dec rdx", "xor rsi, rax",
                 "lea rdi, [rax + rdx*2 + 5]",
                 "cmovnz r10, rax", "setc r11b",
@@ -271,10 +331,118 @@ def test_fused_park_resume_seam_randomized(seed):
     assert int(np.asarray(r1.machine.icount)[0]) == emu.icount
     fused, instr = _occupancy(r1)
     assert 0 < fused < instr  # genuinely mixed: both engines retired work
-    # CTR_INSTR == icount invariant survives the fused ladder
+    # park attribution: every park here is a SUBSET park (cold opclass),
+    # never a memory park — the split must say so
     ctr = np.asarray(r1.machine.ctr)
+    assert ctr[:, CTR_PARK_SUBSET].sum() > 0
+    assert ctr[:, CTR_PARK_MEM].sum() == 0
+    # CTR_INSTR == icount invariant survives the fused ladder
     icount = np.asarray(r1.machine.icount)
     assert (ctr[:, CTR_INSTR] == icount.astype(np.uint32)).all()
+
+
+@pytest.mark.parametrize("case", ("large2m", "fault", "overlay"))
+def test_fused_walk_differential(case):
+    """In-kernel page walk vs translate_vec_l, differentially: the XLA
+    ladder translates through mem/paging.py, the kernel through its own
+    scalar u32-limb walk — 2MiB large-page mappings, non-present holes
+    (PAGE_FAULT with the exact faulting address), and overlay-shadowed
+    frames (a host write into the lane overlay that loads must observe)
+    all agree bit-exactly between the ladders."""
+    if case == "large2m":
+        from tests.asmhelper import assemble
+        from wtf_tpu.mem.physmem import PhysMem
+        from wtf_tpu.snapshot.synthetic import SyntheticSnapshotBuilder
+
+        big_gva = 0x4000_0000
+        code_base = 0x0001_4000_1000
+        asm = f"""
+            mov rbx, {big_gva}
+            mov rax, [rbx + 0x1F0000]
+            add rax, [rbx + 8]
+            mov [rbx + 0x100], rax
+            push rax
+            pop rcx
+            int3
+        """
+        b = SyntheticSnapshotBuilder()
+        b.write(code_base, assemble(asm))
+        b.map(0x7FFF_B000, 0x5000)              # stack
+        # sibling 4K mapping so the 2MiB PS entry's PML4E/PDPTE parents
+        # exist (same 1GiB region, different 2MiB region)
+        b.map(big_gva + 0x20_0000, 0x1000)
+        gpa = 0x0060_0000
+        b.add_large_page_mapping(big_gva, gpa, 21)
+
+        def phys_write(at, blob):
+            b._phys_page(at >> 12)[at & 0xFFF:(at & 0xFFF) + len(blob)] \
+                = blob
+
+        phys_write(gpa + 0x1F0000,
+                   (0x1111_2222_3333_4444).to_bytes(8, "little"))
+        phys_write(gpa + 8, (0x10).to_bytes(8, "little"))
+        pages, cpu = b.build(rip=code_base, rsp=0x7FFF_F000 - 0x100)
+        snap = Snapshot(physmem=PhysMem.from_pages(pages), cpu=cpu)
+        out = []
+        for mode in ("off", "on"):
+            r = Runner(snap, n_lanes=2, chunk_steps=64, fused_step=mode)
+            out.append((r, r.run()))
+        (r0, s0), (r1, s1) = out
+        _assert_ladders_equal(r0, s0, r1, s1, check_mem=True)
+        assert int(np.asarray(r1.machine.gpr)[0, 1]) \
+            == 0x1111_2222_3333_4454
+        fused, instr = _occupancy(r1)
+        assert fused == instr  # the large-page walk stayed in-kernel
+        return
+
+    if case == "fault":
+        asm = f"""
+            mov rbx, {DATA_BASE}
+            mov rax, [rbx]
+            mov rcx, [rbx + 0x200000]
+            int3
+        """
+        data = {DATA_BASE: b"\x55" * 0x1000}
+        (r0, s0), (r1, s1) = _run_pair(asm, data=data)
+        assert all(StatusCode(int(x)) == StatusCode.PAGE_FAULT
+                   for x in s1)
+        _assert_ladders_equal(r0, s0, r1, s1)
+        for field in ("fault_gva", "fault_write"):
+            assert np.array_equal(np.asarray(getattr(r0.machine, field)),
+                                  np.asarray(getattr(r1.machine, field)))
+        assert int(np.asarray(r1.machine.fault_gva)[0]) \
+            == DATA_BASE + 0x200000
+        # the park split attributes this as a MEMORY park, not subset
+        ctr = np.asarray(r1.machine.ctr)
+        assert ctr[:, CTR_PARK_MEM].sum() > 0
+        return
+
+    # overlay: a HOST write lands in the lane overlay (delta row); the
+    # kernel's loads must read through it, and a kernel store to the
+    # same page must merge with it
+    asm = f"""
+        mov rbx, {DATA_BASE}
+        mov rax, [rbx + 0x10]
+        mov [rbx + 0x18], rax
+        add rax, [rbx + 0x18]
+        int3
+    """
+    data = {DATA_BASE: bytes(0x1000)}
+    results = []
+    for mode in ("off", "on"):
+        r = _make_runner(asm, data=data, n_lanes=2, fused_step=mode)
+        view = r.view()
+        for lane in range(2):
+            view.virt_write(lane, DATA_BASE + 0x10,
+                            (0xDEAD_BEEF_0BAD_F00D).to_bytes(8, "little"))
+        r.push(view)
+        results.append((r, r.run()))
+    (r0, s0), (r1, s1) = results
+    _assert_ladders_equal(r0, s0, r1, s1, check_mem=True)
+    assert int(np.asarray(r1.machine.gpr)[0, 0]) \
+        == (2 * 0xDEAD_BEEF_0BAD_F00D) & ((1 << 64) - 1)
+    fused, instr = _occupancy(r1)
+    assert fused == instr
 
 
 def test_fused_breakpoint_park_and_bp_skip_resume():
@@ -322,9 +490,11 @@ def test_fused_breakpoint_park_and_bp_skip_resume():
 
 @pytest.mark.slow
 def test_fused_occupancy_demo_tlv_hot_loop():
-    """The acceptance bar: >= 80% of retired instructions execute
-    in-kernel on the demo_tlv hot loop (the long type-1 sum workload the
-    bench's microbench uses).
+    """The acceptance bar (PR 12): >= 95% of retired instructions
+    execute in-kernel on the demo_tlv hot loop — with the page walk and
+    delta-overlay probe in-kernel, the parser's memory-operand loop body
+    no longer parks (measured 100%: the only parks left are the finish
+    breakpoint's).
 
     `slow`: the demo_tlv image shapes force a second one-shot
     trace+compile of the fused executor (~20s on the 1-core CI box) on
@@ -350,7 +520,7 @@ def test_fused_occupancy_demo_tlv_hot_loop():
     r.run()
     fused, instr = _occupancy(r)
     assert instr > 1000
-    assert fused / instr >= 0.80, (fused, instr, fused / instr)
+    assert fused / instr >= 0.95, (fused, instr, fused / instr)
 
 
 @pytest.mark.slow

@@ -24,16 +24,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from wtf_tpu.telemetry.events import read_events  # noqa: E402
-
-# Span leaves that measure DEVICE work (each is fenced with
-# jax.block_until_ready before its span closes): the device-step/
-# pallas-step executors, the fused devmut generation+insert waits
-# ("device" under mutate/insert), the overlay restore, and the coverage
-# readback.  Everything else inside a top-level phase is host time.
-DEVICE_SPAN_LEAVES = frozenset((
-    "device", "device-step", "pallas-step", "overlay-restore",
-    "cov-readback",
-))
+from wtf_tpu.telemetry.spans import DEVICE_SPAN_LEAVES  # noqa: E402,F401
 
 
 def wall_breakdown(phase_seconds: dict) -> dict:
@@ -331,6 +322,13 @@ def summarize(path) -> dict:
                             for path in (metrics.get("phase.seconds")
                                          or {})))
                 else None),
+            # WHY lanes left the kernel (interp/pstep.py park split):
+            # subset = cold opclass / armed bp / SMC-risk code window,
+            # mem = failing/unwritable walk or overlay-slot exhaustion
+            # mid-window — one opaque number used to hide the reason
+            "fused_park_subset": metrics.get("device.fused_park_subset",
+                                             0),
+            "fused_park_mem": metrics.get("device.fused_park_mem", 0),
         },
         "mesh": mesh,
         "triage": triage,
@@ -390,7 +388,9 @@ def _print_human(s: dict) -> None:
             print(f"  {opclass:<12} {rate}")
     dev = s["device"]
     fused = (f" fused_steps={dev['fused_steps']}"
-             f" (occupancy {dev['fused_occupancy'] * 100:.1f}%)"
+             f" (occupancy {dev['fused_occupancy'] * 100:.1f}%; parks "
+             f"subset={dev.get('fused_park_subset', 0)} "
+             f"mem={dev.get('fused_park_mem', 0)})"
              if dev.get("fused_occupancy") is not None else "")
     print(f"device counters: instructions={dev['instructions']} "
           f"mem_faults={dev['mem_faults']} "

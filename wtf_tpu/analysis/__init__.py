@@ -30,7 +30,8 @@ from typing import List, Optional
 from wtf_tpu.analysis.findings import Finding  # noqa: F401
 from wtf_tpu.analysis.parity import check_fused_parity  # noqa: F401
 from wtf_tpu.analysis.rules import (  # noqa: F401
-    FAMILIES, check_budget, check_mesh_collectives, check_no_u64,
+    FAMILIES, apply_rebaseline, check_budget, check_mesh_collectives,
+    check_no_u64,
     check_seam_bitcast_only, check_shard_stability, check_signature_stable,
     check_strong_inputs, count_collective_ops, count_data_dependent_ops,
     run_dtype_family, run_lint, run_mesh_family,
@@ -52,13 +53,19 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rebaseline", action="store_true",
                    help="measure the kernel-count budget and REWRITE the "
                         "budget file instead of checking it (record why "
-                        "in PERF.md)")
+                        "in PERF.md).  Ratcheted: refuses to record a "
+                        "total INCREASE without --allow-regression")
+    p.add_argument("--allow-regression", action="store_true",
+                   help="let --rebaseline record a kernel/collective "
+                        "budget increase (a conscious perf giveback — "
+                        "name the reason in PERF.md)")
     p.add_argument("--telemetry-dir", default=None,
                    help="write lint findings as events.jsonl records")
     return p
 
 
 def lint_main(families=None, budgets=None, rebaseline: bool = False,
+              allow_regression: bool = False,
               as_json: bool = False, registry=None, events=None,
               out=None) -> int:
     """Run the lint and print results; returns the process exit code
@@ -80,9 +87,16 @@ def lint_main(families=None, budgets=None, rebaseline: bool = False,
         except Exception:  # noqa: BLE001 - backend already initialized
             pass
     t0 = time.time()
-    findings, info = run_lint(families=families, budgets_path=budgets,
-                              rebaseline=rebaseline, registry=registry,
-                              events=events)
+    try:
+        findings, info = run_lint(families=families, budgets_path=budgets,
+                                  rebaseline=rebaseline,
+                                  allow_regression=allow_regression,
+                                  registry=registry, events=events)
+    except ValueError as e:
+        # operator-facing refusals (the rebaseline ratchet, bad family
+        # lists) print as clean one-liners, not tracebacks
+        print(f"wtf-tpu lint: {e}", file=out)
+        return 1
     wall = round(time.time() - t0, 1)
     if as_json:
         print(json.dumps({
@@ -120,7 +134,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 argv=list(argv) if argv is not None else sys.argv[1:])
     try:
         return lint_main(families=families, budgets=args.budgets,
-                         rebaseline=args.rebaseline, as_json=args.json,
+                         rebaseline=args.rebaseline,
+                         allow_regression=args.allow_regression,
+                         as_json=args.json,
                          registry=registry, events=events)
     finally:
         events.emit("run-end", metrics=registry.dump())

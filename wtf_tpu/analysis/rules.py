@@ -450,6 +450,33 @@ def run_tenant_rules(budgets_path: Optional[Path] = None,
     return findings, {"tenant_counts": counts, "entry": entry}
 
 
+def apply_rebaseline(budgets: Dict, measured: Dict,
+                     allow_regression: bool = False) -> Dict:
+    """Merge freshly measured budget entries over the checked-in ones —
+    RATCHETED: an entry whose `total` INCREASED over the checked-in
+    value is refused (ValueError naming every offender) unless
+    `allow_regression`.  Every decrement is a wall-clock win on TPU
+    (step cost tracks kernel count), so a giveback must be a conscious,
+    named act.  Returns the merged dict; pure, so tests can pin the
+    ratchet without paying a trace."""
+    regressions = [
+        (name, budgets[name].get("total"), entry.get("total"))
+        for name, entry in measured.items()
+        if name in budgets
+        and entry.get("total", 0) > budgets[name].get("total", 0)]
+    if regressions and not allow_regression:
+        detail = ", ".join(f"{n}: {old} -> {new}"
+                           for n, old, new in regressions)
+        raise ValueError(
+            f"--rebaseline would RAISE a kernel/collective budget "
+            f"({detail}); the pin is a ratchet — re-run with "
+            f"--allow-regression and record why in PERF.md, or fix "
+            f"the regression")
+    merged = dict(budgets)
+    merged.update(measured)
+    return merged
+
+
 def load_budgets(path: Optional[Path] = None) -> Dict:
     path = Path(path) if path else BUDGETS_PATH
     return json.loads(path.read_text())
@@ -753,11 +780,19 @@ def run_mesh_family(budgets_path: Optional[Path] = None,
 def run_lint(families: Optional[Sequence[str]] = None,
              budgets_path: Optional[Path] = None,
              rebaseline: bool = False,
+             allow_regression: bool = False,
              registry=None, events=None) -> Tuple[List[Finding], Dict]:
     """Run the requested rule families (default: all) against the real
     tree on the current (CPU) backend.  Returns (findings, info); wires
     results into the telemetry registry under `analysis.*` and emits one
-    `lint-finding` event per finding when an event sink is given."""
+    `lint-finding` event per finding when an event sink is given.
+
+    The kernel-count pin is a RATCHET: `rebaseline` re-pins measured
+    counts as usual, but REFUSES to record a budget whose `total`
+    INCREASED over the checked-in value unless `allow_regression` is
+    set — every decrement is a wall-clock win on TPU (step cost tracks
+    kernel count), so giving one back must be a conscious, named act
+    (`--allow-regression`, recorded in PERF.md)."""
     from wtf_tpu.telemetry import NULL, Registry
 
     registry = registry if registry is not None else Registry()
@@ -897,8 +932,9 @@ def run_lint(families: Optional[Sequence[str]] = None,
         info["seconds"]["mesh"] = round(time.time() - t0, 1)
 
     if rebaseline and measured_budgets:
-        budgets = load_budgets(budgets_path)
-        budgets.update(measured_budgets)
+        budgets = apply_rebaseline(load_budgets(budgets_path),
+                                   measured_budgets,
+                                   allow_regression=allow_regression)
         info["budgets_written"] = str(save_budgets(budgets, budgets_path))
 
     # telemetry: analysis.* namespace + one event per finding

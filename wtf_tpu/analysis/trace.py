@@ -111,7 +111,7 @@ def build_tenant_runner(quotas=(2, 2), order=("demo_tlv", "demo_kernel"),
 def build_tlv_campaign(n_lanes: int = 64, mutator: str = "mangle",
                        limit: int = 100_000, seed: int = 0x77F,
                        max_len: int = 0x400, registry=None,
-                       **backend_kwargs):
+                       megachunk: int = 0, **backend_kwargs):
     """A demo_tlv FuzzLoop ready to run_one_batch(): tpu backend built
     and initialized, target init, one TLV seed in the corpus, and the
     mutation engine picked by name ("mangle" = best host engine;
@@ -139,7 +139,7 @@ def build_tlv_campaign(n_lanes: int = 64, mutator: str = "mangle",
     mut = (best_mangle_mutator(rng, max_len) if mutator == "mangle"
            else create_mutator(mutator, rng, max_len))
     return FuzzLoop(backend, demo_tlv.TARGET, mut, corpus,
-                    registry=registry)
+                    registry=registry, megachunk=megachunk)
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,7 @@ from wtf_tpu.core.results import (
     Cr3Change, Crash, Ok, OverlayFull, TestcaseResult, Timedout,
 )
 from wtf_tpu.core.results import StatusCode
+from wtf_tpu.interp.machine import CTR_INSTR
 from wtf_tpu.interp.runner import HostView, Runner
 # the ONE coverage merge (reference master's set-union semantics,
 # server.h:816-854) — shared with the mesh backend, which swaps in the
@@ -44,12 +45,6 @@ from wtf_tpu.telemetry import Registry, StatsDict
 from wtf_tpu.utils.hashing import splitmix64
 
 MASK64 = (1 << 64) - 1
-
-_STATUS_TERMINAL_MAP = {
-    StatusCode.OK: lambda self, lane: Ok(),
-    StatusCode.TIMEDOUT: lambda self, lane: Timedout(),
-    StatusCode.CR3_CHANGE: lambda self, lane: Cr3Change(),
-}
 
 
 class TpuBackend(Backend):
@@ -217,6 +212,128 @@ class TpuBackend(Backend):
         return [self._map_result(lane, statuses[lane])
                 for lane in range(self.n_lanes)]
 
+    def run_megachunk(self, mutator, target, max_batches: int,
+                      n_batches: int):
+        """ONE megachunk window (wtf_tpu/fuzz/megachunk.py): up to
+        `n_batches` whole fuzz batches — restore, devmut generation,
+        insert, the run ladder, the finish-breakpoint rewrite and the
+        coverage merge — in one compiled dispatch; the host touches the
+        window only for the status pull and the crash/new-coverage
+        harvest.  `max_batches` is the compiled buffer size (stable
+        across calls so the program compiles once); `n_batches <=
+        max_batches` is this window's effective budget (checkpoint
+        cadence / runs-budget capping).
+
+        Returns a list of (results, new_flags, datas) per PROCESSED
+        batch, in batch order: `results` the per-lane TestcaseResults,
+        `new_flags` the prefix-credit new-coverage flags, `datas` the
+        fetched bytes of crash/new-coverage lanes.  A batch that needed
+        host servicing is finished through the ordinary Runner.run loop
+        before being returned — the cold-start path IS the legacy loop.
+        """
+        import jax
+
+        from wtf_tpu.fuzz.megachunk import NO_FINISH
+
+        runner = self.runner
+        if not self.limit:
+            raise ValueError(
+                "megachunk windows need a nonzero --limit: the in-graph "
+                "run ladder quiesces on the instruction budget")
+        runner.limit = self.limit
+        self._lane_results = {}
+        spans = self.registry.spans
+        spec = mutator.spec
+        n_pages = len(mutator.pfns)
+        finish = spec.finish_gva if spec.finish_gva is not None \
+            else NO_FINISH
+        fn = runner.megachunk_callable(max_batches, n_pages,
+                                       spec.len_gpr, spec.ptr_gpr,
+                                       mutator.rounds)
+        key = ("megachunk", max_batches, n_pages, self.n_lanes,
+               mutator.rounds, runner.exec_sig)
+        from wtf_tpu.interp.runner import _DISPATCHED_EXECUTORS
+
+        if key not in _DISPATCHED_EXECUTORS:
+            _DISPATCHED_EXECUTORS.add(key)
+            self.events.emit("compile", kind="megachunk",
+                             batches=max_batches, lanes=self.n_lanes)
+        # host state staged through the backend view (init-time target
+        # writes) must land BEFORE the window, like run_batch_words
+        if self._view is not None:
+            runner.push(self._view)
+            self._view = None
+        slab_first, slab_rest = mutator.window_slabs()
+        seeds = mutator.window_seeds(max_batches)
+        slab_first, slab_rest, seeds = runner.megachunk_place(
+            slab_first, slab_rest, seeds)
+        pfns = jnp.asarray(np.asarray(mutator.pfns, dtype=np.int32))
+        gva_l = jnp.asarray(np.array(
+            [spec.gva & 0xFFFF_FFFF, (spec.gva >> 32) & 0xFFFF_FFFF],
+            dtype=np.uint32))
+        with spans.span("device") as sp:
+            out = fn(runner.device_tab(), runner.image, runner.machine,
+                     runner.template, slab_first, slab_rest, seeds, pfns,
+                     gva_l, jnp.uint64(finish), jnp.uint64(self.limit),
+                     jnp.int32(n_batches), self._agg_cov, self._agg_edge)
+            sp.fence(out.batches)
+        runner.machine = out.machine
+        self._agg_cov = out.agg_cov
+        self._agg_edge = out.agg_edge
+        self._last_new_words = np.asarray(jax.device_get(out.new_words))
+        b_done = int(jax.device_get(out.batches))
+        incomplete = bool(jax.device_get(out.incomplete))
+        statuses = np.asarray(jax.device_get(out.statuses))
+        flags = np.asarray(jax.device_get(out.new_flags))
+        ctr_sums = np.asarray(jax.device_get(out.ctr_sums))
+        processed = b_done + (1 if incomplete else 0)
+        mutator.consume_window(processed)
+
+        batches = []
+        for b in range(b_done):
+            row = statuses[b]
+            frow = flags[b]
+            runner.fold_counter_totals(ctr_sums[b])
+            if b == b_done - 1 and not incomplete:
+                # the live machine IS this batch's final state (the
+                # window stops on any non-clean terminal), so crash
+                # naming reads it exactly like run_batch's path
+                results = [self._map_result(lane, row[lane])
+                           for lane in range(self.n_lanes)]
+            else:
+                # interior batches are clean by the stop rule
+                results = [self._result_from_fields(
+                    StatusCode(int(row[lane])), 0, 0, 0, "")
+                    for lane in range(self.n_lanes)]
+            snap = out.cur if b == processed - 1 else out.prev
+            datas = {}
+            wanted = [lane for lane in range(self.n_lanes)
+                      if frow[lane] or isinstance(results[lane], Crash)]
+            if wanted:
+                mutator.set_current(snap.words, snap.lens)
+                datas = mutator.fetch(wanted)
+            self._new_lane = frow
+            self.stats["batches"] += 1
+            self.stats["testcases"] += self.n_lanes
+            self.stats["instructions"] += int(ctr_sums[b][CTR_INSTR])
+            batches.append((results, frow, datas))
+
+        if incomplete:
+            # finish the in-flight batch through the ordinary servicing
+            # loop (decode/SMC/oracle/breakpoints), then account it the
+            # host way — this IS the batch-at-a-time path
+            statuses_fin = runner.run(bp_handler=self._dispatch_bp)
+            self._finish_batch(statuses_fin, self.n_lanes)
+            results = [self._map_result(lane, statuses_fin[lane])
+                       for lane in range(self.n_lanes)]
+            frow = np.asarray(self._new_lane)
+            mutator.set_current(out.cur.words, out.cur.lens)
+            wanted = [lane for lane in range(self.n_lanes)
+                      if frow[lane] or isinstance(results[lane], Crash)]
+            datas = mutator.fetch(wanted) if wanted else {}
+            batches.append((results, frow, datas))
+        return batches
+
     # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
     def coverage_state(self):
         """(cov words, edge words) aggregate bitmaps as host arrays — the
@@ -278,32 +395,48 @@ class TpuBackend(Backend):
                 result = self._lane_results[lane]
                 view.set_status(lane, _result_status(result))
 
-    def _map_result(self, lane: int, status_val: int) -> TestcaseResult:
-        if lane in self._lane_results:
-            return self._lane_results[lane]
-        status = StatusCode(int(status_val))
-        if status in _STATUS_TERMINAL_MAP:
-            return _STATUS_TERMINAL_MAP[status](self, lane)
-        gva = int(np.asarray(self.runner.machine.fault_gva)[lane])
+    def _result_from_fields(self, status: StatusCode, gva: int, write: int,
+                            rip: int, detail: str) -> TestcaseResult:
+        """Terminal status + crash-naming fields -> TestcaseResult — the
+        ONE mapping shared by the per-lane machine read (_map_result) and
+        the megachunk window's batch rows, so the two dispatch paths name
+        crashes identically."""
+        if status == StatusCode.OK:
+            return Ok()
+        if status == StatusCode.TIMEDOUT:
+            return Timedout()
+        if status == StatusCode.CR3_CHANGE:
+            return Cr3Change()
         if status == StatusCode.CRASH:
             return Crash(f"crash-int-{gva:#x}")
         if status == StatusCode.PAGE_FAULT:
-            write = int(np.asarray(self.runner.machine.fault_write)[lane])
-            rip = int(np.asarray(self.runner.machine.rip)[lane])
             if gva == rip and not write:
                 kind = "execute"  # fetch-address fault (A/V-execute analog)
             else:
                 kind = "write" if write else "read"
             return Crash(f"crash-{kind}-{gva:#x}")
         if status == StatusCode.DIVIDE_ERROR:
-            rip = int(np.asarray(self.runner.machine.rip)[lane])
             return Crash(f"crash-de-{rip:#x}")
         if status == StatusCode.OVERLAY_FULL:
             return OverlayFull()
         if status == StatusCode.HARD_ERROR:
-            detail = self.runner.lane_errors.get(lane, "hard-error")
             return Crash(f"crash-{detail.split()[0]}")
         raise AssertionError(f"unmapped terminal status {status!r}")
+
+    def _map_result(self, lane: int, status_val: int) -> TestcaseResult:
+        if lane in self._lane_results:
+            return self._lane_results[lane]
+        status = StatusCode(int(status_val))
+        if status in (StatusCode.OK, StatusCode.TIMEDOUT,
+                      StatusCode.CR3_CHANGE):
+            return self._result_from_fields(status, 0, 0, 0, "")
+        m = self.runner.machine
+        return self._result_from_fields(
+            status,
+            int(np.asarray(m.fault_gva)[lane]),
+            int(np.asarray(m.fault_write)[lane]),
+            int(np.asarray(m.rip)[lane]),
+            self.runner.lane_errors.get(lane, "hard-error"))
 
     # -- Backend facade (single testcase == lane 0) ------------------------
     def run(self) -> TestcaseResult:
@@ -483,8 +616,13 @@ class TpuBackend(Backend):
         fused = self.registry.counter("device.fused_steps").value
         if fused or getattr(self.runner, "fused_enabled", False):
             instr = max(self.registry.counter("device.instructions").value, 1)
+            # the park split answers WHY lanes left the kernel: a cold
+            # opclass (subset) vs a memory fault / overlay exhaustion
+            ps = self.registry.counter("device.fused_park_subset").value
+            pm = self.registry.counter("device.fused_park_mem").value
             print(f"[tpu] fused steps: {h(fused)} "
-                  f"({fused / instr:.1%} of instructions in-kernel)")
+                  f"({fused / instr:.1%} of instructions in-kernel; "
+                  f"parks: subset={h(ps)} mem={h(pm)})")
         by_class = s.get("fallbacks_by_opclass", {})
         if by_class:
             # attribution for the fallback total (VERDICT r5 item 3):

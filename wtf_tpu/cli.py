@@ -214,6 +214,15 @@ def build_parser() -> argparse.ArgumentParser:
                            "(tpu backend + a target with a "
                            "DeviceInsertSpec only)")
     camp.add_argument("--stop-on-crash", action="store_true")
+    camp.add_argument("--megachunk", type=int, default=0, metavar="N",
+                      help="one-dispatch multi-batch windows (wtf_tpu/"
+                           "fuzz/megachunk): fold up to N whole batches "
+                           "— restore, devmut generation, insert, the "
+                           "run ladder, the coverage merge — into ONE "
+                           "compiled program per dispatch, so per-batch "
+                           "host work collapses to the status pull and "
+                           "find harvest.  Needs --mutator devmangle "
+                           "and a nonzero --limit; 0 = off")
     camp.add_argument("--checkpoint-every", type=int, default=0,
                       metavar="N",
                       help="crash-safe checkpointing (wtf_tpu/resume): "
@@ -415,7 +424,12 @@ def build_parser() -> argparse.ArgumentParser:
                       help="alternate budgets.json")
     lint.add_argument("--rebaseline", action="store_true",
                       help="rewrite the kernel-count budget file from the "
-                           "current tree (record why in PERF.md)")
+                           "current tree (record why in PERF.md).  "
+                           "Ratcheted: refuses a total increase without "
+                           "--allow-regression")
+    lint.add_argument("--allow-regression", action="store_true",
+                      help="let --rebaseline record a budget INCREASE "
+                           "(conscious perf giveback; name it in PERF.md)")
     lint.add_argument("--telemetry-dir", type=Path, default=None,
                       help="write lint findings into events.jsonl")
     return parser
@@ -663,6 +677,7 @@ def cmd_campaign(args) -> int:
                            checkpoint_every=args.checkpoint_every,
                            checkpoint_dir=args.checkpoint_dir,
                            resume=args.resume, store=args.store,
+                           megachunk=args.megachunk,
                            paths=_paths_from(args))
     # checkpoint dir defaulting: explicit flag > the resume dir (a
     # resumed campaign keeps checkpointing in place) > <target>/checkpoint
@@ -723,7 +738,7 @@ def cmd_campaign(args) -> int:
                         registry=registry, events=events,
                         checkpoint_dir=ckpt_dir,
                         checkpoint_every=opts.checkpoint_every,
-                        store=store)
+                        store=store, megachunk=opts.megachunk)
         if opts.resume:
             from wtf_tpu.resume import load_campaign, restore_campaign
 
@@ -1066,7 +1081,9 @@ def cmd_lint(args) -> int:
     families = args.families.split(",") if args.families else None
     with _telemetry_for(args) as (registry, events):
         return lint_main(families=families, budgets=args.budgets,
-                         rebaseline=args.rebaseline, as_json=args.json,
+                         rebaseline=args.rebaseline,
+                         allow_regression=args.allow_regression,
+                         as_json=args.json,
                          registry=registry, events=events)
 
 

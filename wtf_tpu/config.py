@@ -151,6 +151,10 @@ class CampaignOptions:
     resume: Optional[Path] = None
     # content-addressed corpus/crash store root (wtf_tpu/fleet/store)
     store: Optional[Path] = None
+    # one-dispatch multi-batch windows (wtf_tpu/fuzz/megachunk): up to N
+    # whole batches — restore/mutate/insert/execute/reduce — per
+    # compiled dispatch (0 = off; needs --mutator devmangle + --limit)
+    megachunk: int = 0
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
 
 

@@ -110,6 +110,19 @@ class DeviceCorpus:
             self._uploaded_count = self.count
         return (*self._dev, synced)
 
+    def arrays_pair(self) -> Tuple:
+        """((data, lens, cumw) as-last-uploaded, (data, lens, cumw)
+        current, synced) — the megachunk window's two slab views
+        (fuzz/megachunk.py slab schedule).  The as-uploaded view is what
+        a legacy prelaunched batch would have sampled (the lag-preserving
+        first batch of a window); identical to the current view when no
+        add landed since the last upload."""
+        old = self._dev
+        data, lens, cumw, synced = self.arrays()
+        if old is None:
+            old = (data, lens, cumw)
+        return old, (data, lens, cumw), synced
+
     # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
     def _note_undo(self, slot: int) -> None:
         """Record `slot`'s pre-image before its first mutation since the

@@ -160,6 +160,65 @@ class DevMangleMutator(Mutator):
                                "(call take_batch first)")
         return self._current
 
+    # -- megachunk window seams (wtf_tpu/fuzz/megachunk.py) ----------------
+    def window_slabs(self) -> Tuple:
+        """(slab_first, slab_rest) device-array triples for one megachunk
+        window: the first batch samples the slab as the device LAST saw
+        it — which the harvest pinned via `snapshot_entitled_slab` to
+        exclude exactly the PREVIOUS window's final batch's finds (the
+        legacy prelaunch lag, preserved exactly) — later batches the
+        current host slab.  Pays the re-upload the legacy loop's next
+        take_batch would have paid."""
+        first, rest, synced = self.corpus.arrays_pair()
+        if synced:
+            self.stats["corpus_syncs"] += 1
+        self.stats["corpus_slots"] = len(self.corpus)
+        return first, rest
+
+    def window_seeds(self, n: int):
+        """Per-lane splitmix seeds for the next `n` batches of the
+        stream — hostref.lane_seeds at consecutive ABSOLUTE batch
+        indices, so the byte stream is the same whether batches are
+        generated one-at-a-time or in-window (no double-generate, no
+        stream skew; tests/test_megachunk.py pins this vs hostref)."""
+        import jax.numpy as jnp
+
+        seeds = np.stack([
+            hostref.lane_seeds(self.seed, self._batch + j, self.n_lanes)
+            for j in range(n)])
+        return jnp.asarray(seeds)
+
+    def snapshot_entitled_slab(self) -> None:
+        """Pin the slab view the NEXT window's FIRST batch is entitled
+        to.  The legacy prelaunch generates batch k+1 during batch k's
+        harvest BEFORE k's finds are folded in, so batch k+1 samples
+        finds <= k-1.  The harvest therefore calls this just BEFORE the
+        window's final processed batch's corpus adds: the re-upload
+        makes the as-uploaded view (window_slabs' slab_first) exclude
+        exactly that batch's finds — one extra upload only on windows
+        that found something (a clean window's slab is not dirty and
+        this is free)."""
+        *_rest, synced = self.corpus.arrays()
+        if synced:
+            self.stats["corpus_syncs"] += 1
+
+    def consume_window(self, n: int) -> None:
+        """Advance the stream cursor past `n` in-graph-generated batches
+        (the megachunk's take_batch).  No prelaunch state exists in
+        window mode, so checkpoints carry pending=False and resume
+        regenerates nothing."""
+        self._batch += n
+        self._pending = None
+        self.stats["batches"] += n
+        self.stats["generated"] += n * self.n_lanes
+
+    def set_current(self, words, lens) -> None:
+        """Point the harvest seam (fetch / current_batch) at one window
+        batch's device arrays — the megachunk outputs snapshots of the
+        last two batches; the driver swaps each in before fetching its
+        crash/new-coverage lanes."""
+        self._current = (words, lens)
+
     # -- host harvest seam -------------------------------------------------
     def fetch(self, lanes: Sequence[int]) -> Dict[int, bytes]:
         """Pull the generated bytes of just `lanes` to the host (crash
@@ -173,12 +232,23 @@ class DevMangleMutator(Mutator):
         lens_h = np.asarray(jax.device_get(lens))
         # ONE gather + ONE transfer for all wanted lanes — per-lane
         # device_get would cost len(lanes) round trips, and early
-        # batches mark nearly every lane as new coverage
-        lane_arr = np.asarray(list(lanes), dtype=np.int32)
+        # batches mark nearly every lane as new coverage.  The index
+        # vector is PADDED to a power-of-two bucket (repeating the first
+        # lane): the gather's jit executable keys on the index SHAPE,
+        # and find counts vary per batch — unpadded, a find-heavy
+        # campaign compiles a fresh gather for every distinct count
+        # (tens of ms each, a measurable slice of harvest host time).
+        lane_list = list(lanes)
+        bucket = 1
+        while bucket < len(lane_list):
+            bucket *= 2
+        lane_arr = np.asarray(
+            lane_list + [lane_list[0]] * (bucket - len(lane_list)),
+            dtype=np.int32)
         rows = np.asarray(jax.device_get(words[lane_arr]))
         out = {int(lane): rows[j].tobytes()[:int(lens_h[lane])]
-               for j, lane in enumerate(lane_arr)}
-        self.stats["fetched"] += len(lanes)
+               for j, lane in enumerate(lane_list)}
+        self.stats["fetched"] += len(lane_list)
         return out
 
     # -- checkpoint/resume (wtf_tpu/resume) --------------------------------

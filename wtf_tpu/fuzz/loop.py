@@ -146,6 +146,7 @@ class FuzzLoop:
         checkpoint_dir: Optional[Path] = None,
         checkpoint_every: int = 0,
         store=None,
+        megachunk: int = 0,
     ):
         self.backend = backend
         self.target = target
@@ -168,6 +169,26 @@ class FuzzLoop:
             mutator.bind(backend, target, registry=self.registry,
                          events=self.events)
             mutator.seed_from(corpus)
+        # one-dispatch multi-batch windows (wtf_tpu/fuzz/megachunk.py):
+        # generation + insert + the run ladder + the coverage merge +
+        # restore fused into ONE compiled program per up-to-`megachunk`
+        # batches; host work per batch collapses to the status pull and
+        # the crash/new-coverage harvest
+        self.megachunk = int(megachunk or 0)
+        if self.megachunk:
+            if not self.mutate_on_device:
+                raise ValueError(
+                    "--megachunk needs the device mutation engine "
+                    "(--mutator devmangle): generation must live "
+                    "in-graph for the window to fuse it")
+            if not hasattr(backend, "run_megachunk"):
+                raise ValueError(
+                    "--megachunk requires the batched tpu backend")
+            if not getattr(backend, "limit", 0):
+                raise ValueError(
+                    "--megachunk needs a nonzero --limit: the in-graph "
+                    "run ladder quiesces on the instruction budget")
+        self._runs_budget = 0
         self.stats = CampaignStats(self.registry)
         self.stats_every = stats_every
         self.crash_names = set()
@@ -230,13 +251,17 @@ class FuzzLoop:
         return 1
 
     def _harvest_lane(self, lane: int, data: bytes, result: TestcaseResult,
-                      requeue: bool = False) -> int:
-        """The ONE per-lane harvest body shared by the host and device
-        batch paths: result accounting (+ optional overlay-full requeue)
-        and the new-coverage -> corpus/mutator/event chain.  Returns 1
-        for a crash."""
+                      requeue: bool = False, found_new=None) -> int:
+        """The ONE per-lane harvest body shared by the host, device and
+        megachunk batch paths: result accounting (+ optional
+        overlay-full requeue) and the new-coverage -> corpus/mutator/
+        event chain.  `found_new` overrides the backend's last-batch
+        flag for callers harvesting several batches at once (the
+        megachunk window's per-batch flag rows).  Returns 1 for a
+        crash."""
         crashes = self._account(data, result, requeue=requeue, lane=lane)
-        if self.backend.lane_found_new_coverage(lane):
+        if (self.backend.lane_found_new_coverage(lane)
+                if found_new is None else found_new):
             self.stats.new_coverage += 1
             if self.corpus.add(data):
                 self.mutator.on_new_coverage(data)
@@ -256,8 +281,12 @@ class FuzzLoop:
             self.backend.restore()
 
     def run_one_batch(self) -> int:
-        """Returns the number of crashes found in this batch."""
+        """Returns the number of crashes found in this batch (for a
+        megachunk window: in the whole window; the window's extra
+        completed batches advance `batches_done` internally)."""
         if self.mutate_on_device:
+            if self.megachunk:
+                return self._run_megachunk_window()
             return self._run_one_batch_device()
         spans = self.registry.spans
         with spans.span("mutate"):
@@ -317,6 +346,52 @@ class FuzzLoop:
                                               result)
         self._emit_timeouts(timeouts_before)
         self._restore_batch()
+        return crashes
+
+    def _run_megachunk_window(self) -> int:
+        """One megachunk window: up to `self.megachunk` whole batches in
+        ONE compiled dispatch (restore/mutate/insert/execute/reduce all
+        in-graph), then a host harvest of just the batches' finds.  The
+        effective window is capped so batch boundaries still line up
+        with the checkpoint cadence and the runs budget — a `--resume`
+        from any such boundary stays bit-identical (PR-8 contract)."""
+        spans = self.registry.spans
+        window = self.megachunk
+        if self.checkpoint_every:
+            window = min(window, self.checkpoint_every
+                         - self.batches_done % self.checkpoint_every)
+        if self._runs_budget:
+            remaining = self._runs_budget - self.stats.testcases
+            lanes = self.batch_size
+            window = min(window, max(1, -(-int(remaining) // lanes)))
+        with spans.span("execute"):
+            batches = self.backend.run_megachunk(
+                self.mutator, self.target, self.megachunk, window)
+        crashes = 0
+        timeouts_before = self.stats.timeouts
+        with spans.span("harvest"):
+            for j, (results, flags, datas) in enumerate(batches):
+                if j == len(batches) - 1:
+                    # pin the NEXT window's entitled slab view BEFORE
+                    # the final batch's finds enter the corpus — the
+                    # legacy prelaunch samples batch k+1's slab at
+                    # exactly this point of batch k's harvest, and the
+                    # bit-identical claim rides on reproducing it
+                    self.mutator.snapshot_entitled_slab()
+                for lane, result in enumerate(results):
+                    crashes += self._harvest_lane(
+                        lane, datas.get(lane, b""), result,
+                        found_new=bool(flags[lane]))
+        self._emit_timeouts(timeouts_before)
+        with spans.span("restore"):
+            # machine restore is in-graph (each batch's first phase);
+            # only the target's host-side state rolls back here, ONCE
+            # per window — megachunk targets are declarative-insert
+            # targets whose restore carries no per-batch host state
+            self.target.restore()
+        # the caller (fuzz) advances batches_done by one per
+        # run_one_batch; fold this window's extra completed batches in
+        self.batches_done += len(batches) - 1
         return crashes
 
     def _save_crash(self, data: bytes, result: Crash,
@@ -401,6 +476,7 @@ class FuzzLoop:
         """Run until `runs` testcases executed (0 = forever; the CLI maps
         --runs=0 to `minset` instead, matching the reference)."""
         self.reshard_to = None
+        self._runs_budget = runs
         while runs == 0 or self.stats.testcases < runs:
             found = self.run_one_batch()
             self.batches_done += 1

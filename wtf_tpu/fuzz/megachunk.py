@@ -1,0 +1,314 @@
+"""The megachunk: a whole multi-batch fuzz window as ONE compiled program.
+
+The batch-at-a-time device loop (fuzz/loop.py `_run_one_batch_device`)
+still consults the host between every phase of every batch: devmut
+generation, the fused insert, the chunk ladder, the coverage merge, and
+the overlay restore are five separate dispatches with host glue between
+them.  This module folds them into one compiled multi-batch program — the
+Concordia posture ROADMAP item 2(b) names: per batch, IN-GRAPH,
+
+    restore -> devmut generate -> device insert -> run to quiescence ->
+    finish-breakpoint rewrite -> prefix-credit coverage merge
+
+iterated under a `lax.while_loop` for up to `n_batches` batches, so the
+host's per-batch share collapses to the status pull and the harvest of
+crash/new-coverage lanes.  The window returns early exactly when the host
+is genuinely needed:
+
+  * a batch ends with a SERVICEABLE lane (decode miss, SMC, oracle
+    fallback, a non-finish breakpoint, deliverable fault): the machine
+    comes back mid-batch and the ordinary Runner.run servicing loop
+    finishes that batch — the cold-start path, byte-identical to the
+    batch-at-a-time loop's servicing because it IS that loop;
+  * a batch finds NEW COVERAGE: the window runs at most ONE more batch
+    and stops, so the host can fold the finds into the corpus slab
+    before any batch that is entitled to see them is generated;
+  * a batch has a NON-CLEAN terminal (crash/fault/overlay-full): the
+    window stops right there, so the machine the host reads for crash
+    naming and stack-hash bucketing is exactly that batch's final state.
+
+Slab schedule (the PR-6 prelaunch lag, preserved exactly): batch k's
+generation samples the slab with finds from batches <= k-2.  The window
+therefore takes TWO slab views — `slab_first` for its first batch,
+`slab_rest` (the current host slab) for the batches after — and the
+find-stop rule above guarantees no batch inside a window ever needs a
+slab newer than `slab_rest`.  `slab_first` is the view the harvest
+PINNED just before the previous window's FINAL batch's corpus adds
+(DevMangleMutator.snapshot_entitled_slab): the next window's first
+batch is absolute batch m+1 where m was that final batch, so its
+entitlement is finds <= m-1 — exactly the pre-m's-adds state, and
+exactly when the legacy prelaunch would have sampled it.  With
+`n_batches=1` the program IS the batch-at-a-time device loop's
+schedule, which is what the parity tests pin (tests/test_megachunk.py:
+12-batch campaigns with finds in IN-GRAPH batches, B=4 vs B=1 vs the
+legacy loop, byte-identical).
+
+The finish-breakpoint rewrite is the declarative form of the stop
+handler every wtf-style target plants at its return address
+(`b.stop(Ok())`): a lane parked at BREAKPOINT with rip ==
+`DeviceInsertSpec.finish_gva` becomes OK in-graph, bit-for-bit what the
+host handler would have done (the breakpointed instruction never
+executes, no coverage bit, no icount).  Targets with richer handlers
+simply park the batch to the host path — correct, just not fused.
+
+The mesh variant wraps the SAME body in shard_map: machine/template/
+seeds lane-sharded, slabs and the uop table replicated, the per-batch
+merge the shard-aware prefix-credit core (meshrun/reduce
+.mesh_merge_local), and the loop-control scalars (stop/find/incomplete)
+all-reduced so every shard's while_loop stays in lockstep.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from wtf_tpu.core.results import StatusCode
+from wtf_tpu.interp.machine import Machine, N_CTRS, _machine_restore_impl
+from wtf_tpu.interp.runner import device_insert_impl
+from wtf_tpu.interp.step import step_lane
+from wtf_tpu.interp.uoptable import UopTable
+from wtf_tpu.mem.physmem import IMAGE_IN_AXES, MemImage, lane_image
+from wtf_tpu.meshrun.reduce import merge_coverage, mesh_merge_local
+
+_RUNNING = int(StatusCode.RUNNING)
+_OK = int(StatusCode.OK)
+_TIMEDOUT = int(StatusCode.TIMEDOUT)
+_CR3 = int(StatusCode.CR3_CHANGE)
+_OVF = int(StatusCode.OVERLAY_FULL)
+_BP = int(StatusCode.BREAKPOINT)
+
+# statuses the host servicing loop owns; PAGE_FAULT/DIVIDE_ERROR join
+# when the campaign delivers guest exceptions (runner.deliver_exceptions)
+SERVICEABLE_BASE = (int(StatusCode.NEED_DECODE), int(StatusCode.SMC),
+                    int(StatusCode.UNSUPPORTED), int(StatusCode.BREAKPOINT))
+SERVICEABLE_DELIVER = SERVICEABLE_BASE + (int(StatusCode.PAGE_FAULT),
+                                          int(StatusCode.DIVIDE_ERROR))
+
+# rip sentinel for "no declarative finish breakpoint": unaligned-odd and
+# non-canonical-adjacent, unreachable as an armed-breakpoint rip
+NO_FINISH = 1
+
+_MEGA_CACHE: dict = {}
+
+
+class MegaSnap(NamedTuple):
+    """Per-batch harvest snapshot carried for the last two processed
+    batches: the generated testcase words/lens, so crash/new-coverage
+    lanes' bytes are fetchable without regenerating the batch.  Crash
+    DETAIL never needs snapshotting — a non-clean terminal stops the
+    window, so the live machine IS that batch's final state."""
+
+    words: jax.Array       # uint32[L, W]
+    lens: jax.Array        # int32[L]
+
+
+class MegaOut(NamedTuple):
+    machine: Machine
+    agg_cov: jax.Array
+    agg_edge: jax.Array
+    batches: jax.Array       # int32: COMPLETED batches this window
+    incomplete: jax.Array    # bool: machine is mid-batch `batches`
+    statuses: jax.Array      # int32[B, L]; -1 = batch not completed
+    new_flags: jax.Array     # bool[B, L] per-batch new-coverage credit
+    ctr_sums: jax.Array      # uint64[B, N_CTRS] per-batch counter totals
+    new_words: jax.Array     # uint32[cov_w] last completed batch's delta
+    prev: MegaSnap
+    cur: MegaSnap
+
+
+def _snap(words, lens) -> MegaSnap:
+    return MegaSnap(words=words, lens=lens)
+
+
+def _make_body(max_batches: int, n_pages: int, len_gpr: int, ptr_gpr: int,
+               rounds: int, deliver: bool, merge_fn, any_fn, sum_fn):
+    """The window body shared by the single-device and mesh programs.
+    `merge_fn` is the batch coverage merge, `any_fn` a (possibly
+    cross-shard) boolean any, `sum_fn` a (possibly psum'd) per-batch
+    counter total."""
+    from wtf_tpu.devmut.engine import generate
+
+    insert = device_insert_impl(n_pages, len_gpr, ptr_gpr)
+    step_v = jax.vmap(step_lane, in_axes=(None, IMAGE_IN_AXES, 0, None))
+    serviceable = SERVICEABLE_DELIVER if deliver else SERVICEABLE_BASE
+    B = max_batches
+
+    def run_quiesce(tab, image, m, limit):
+        """The run-chunk ladder folded in: step until NO lane is RUNNING
+        (decode misses, breakpoints and terminals all leave RUNNING, and
+        a nonzero instruction budget bounds the rest — the driver
+        enforces limit > 0 before building a megachunk)."""
+
+        def cond(mm):
+            return jnp.any(mm.status == jnp.int32(_RUNNING))
+
+        def body(mm):
+            return step_v(tab, image, mm, limit)
+
+        return lax.while_loop(cond, body, m)
+
+    def window(tab: UopTable, image: MemImage, machine: Machine,
+               template: Machine, slab_first: Tuple, slab_rest: Tuple,
+               seeds, pfns, gva_l, finish_l, limit, n_batches,
+               agg_cov, agg_edge) -> MegaOut:
+        n_lanes = machine.status.shape[0]
+        image = lane_image(image, n_lanes)
+        n_words = slab_first[0].shape[1]
+        statuses0 = jnp.full((B, n_lanes), -1, jnp.int32)
+        flags0 = jnp.zeros((B, n_lanes), bool)
+        ctrs0 = jnp.zeros((B, N_CTRS), jnp.uint64)
+        snap0 = MegaSnap(
+            words=jnp.zeros((n_lanes, n_words), jnp.uint32),
+            lens=jnp.zeros((n_lanes,), jnp.int32))
+        nw0 = jnp.zeros_like(agg_cov)
+
+        def cond(carry):
+            b, stop = carry[0], carry[1]
+            return (b < n_batches) & ~stop
+
+        def body(carry):
+            (b, _stop, incomplete, find_b, m, agg_c, agg_e, sts, flags,
+             ctrs, nw, prev, cur) = carry
+            first = b == 0
+            data = jnp.where(first, slab_first[0], slab_rest[0])
+            lens_s = jnp.where(first, slab_first[1], slab_rest[1])
+            cumw = jnp.where(first, slab_first[2], slab_rest[2])
+            m = _machine_restore_impl(m, template)
+            words, lens = generate(data, lens_s, cumw, seeds[b],
+                                   rounds=rounds)
+            m = insert(m, words, lens, pfns, gva_l)
+            m = run_quiesce(tab, image, m, limit)
+            # declarative stop: BREAKPOINT at the finish rip == the
+            # host handler's stop(Ok()) — pre-execution, so no icount /
+            # coverage for the breakpointed instruction, like the host
+            st = jnp.where((m.status == jnp.int32(_BP))
+                           & (m.rip == finish_l), jnp.int32(_OK), m.status)
+            m = m._replace(status=st)
+
+            svc = jnp.zeros_like(st, bool)
+            for s in serviceable:
+                svc = svc | (st == jnp.int32(s))
+            need_service = any_fn(svc)
+            complete = ~need_service
+
+            include = ((st != jnp.int32(_TIMEDOUT))
+                       & (st != jnp.int32(_OVF)))
+            agg_c2, agg_e2, new_lane, new_w = merge_fn(
+                agg_c, agg_e, m.cov, m.edge, include)
+            agg_c3 = jnp.where(complete, agg_c2, agg_c)
+            agg_e3 = jnp.where(complete, agg_e2, agg_e)
+            new_lane = new_lane & complete
+            clean = ((st == jnp.int32(_OK)) | (st == jnp.int32(_TIMEDOUT))
+                     | (st == jnp.int32(_CR3)))
+            crashy = complete & any_fn(~clean)
+            has_cov_find = complete & any_fn(new_lane)
+            find_b2 = jnp.where(has_cov_find & (find_b >= B), b, find_b)
+
+            sts2 = sts.at[b].set(jnp.where(complete, st, sts[b]))
+            flags2 = flags.at[b].set(new_lane)
+            ctrs2 = ctrs.at[b].set(jnp.where(
+                complete, sum_fn(m.ctr), ctrs[b]))
+            nw2 = jnp.where(complete, new_w, nw)
+            prev2, cur2 = cur, _snap(words, lens)
+            b2 = b + complete.astype(jnp.int32)
+            # find-stop: after a new-coverage find at batch j the window
+            # may run j+1 (its slab view is still entitled) and must then
+            # return so the host folds the finds before j+2 generates; a
+            # non-clean terminal stops immediately so the live machine
+            # stays that batch's final state for crash naming/bucketing
+            stop2 = need_service | crashy \
+                | (complete & (b + 1 > find_b2 + 1))
+            return (b2, stop2, incomplete | need_service, find_b2, m,
+                    agg_c3, agg_e3, sts2, flags2, ctrs2, nw2, prev2, cur2)
+
+        init = (jnp.int32(0), jnp.bool_(False), jnp.bool_(False),
+                jnp.int32(B), machine, agg_cov, agg_edge, statuses0,
+                flags0, ctrs0, nw0, snap0, snap0)
+        (b, _stop, incomplete, _fb, m, agg_c, agg_e, sts, flags, ctrs,
+         nw, prev, cur) = lax.while_loop(cond, body, init)
+        return MegaOut(machine=m, agg_cov=agg_c, agg_edge=agg_e,
+                       batches=b, incomplete=incomplete, statuses=sts,
+                       new_flags=flags, ctr_sums=ctrs, new_words=nw,
+                       prev=prev, cur=cur)
+
+    return window
+
+
+def make_megachunk(max_batches: int, n_pages: int, len_gpr: int,
+                   ptr_gpr: int, rounds: int, deliver: bool):
+    """Build (or fetch) the jitted single-device megachunk window:
+    (tab, image, machine, template, slab_first, slab_rest, seeds[B,L,2],
+    pfns, gva_l, finish, limit, n_batches, agg_cov, agg_edge) -> MegaOut.
+
+    No donation: the CPU stand-in is where tier-1 runs this (donation is
+    unsound on XLA CPU, step.make_run_chunk's caveat), and the first
+    hardware window will revisit the policy with the rest of the
+    donation ledger."""
+    key = ("1dev", max_batches, n_pages, len_gpr, ptr_gpr, rounds,
+           deliver)
+    cached = _MEGA_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def sum_fn(ctr):
+        return jnp.sum(ctr.astype(jnp.uint64), axis=0)
+
+    body = _make_body(max_batches, n_pages, len_gpr, ptr_gpr, rounds,
+                      deliver, merge_fn=merge_coverage, any_fn=jnp.any,
+                      sum_fn=sum_fn)
+    fn = jax.jit(body)
+    _MEGA_CACHE[key] = fn
+    return fn
+
+
+def make_mesh_megachunk(max_batches: int, n_pages: int, len_gpr: int,
+                        ptr_gpr: int, rounds: int, deliver: bool, mesh):
+    """The megachunk window per shard under shard_map: machine/template/
+    seed-stream/snapshots lane-sharded, slabs + uop table + aggregates
+    replicated, the per-batch merge the shard-aware prefix-credit core,
+    and every loop-control scalar all-reduced so the shards' while_loops
+    stay in lockstep (identical trip counts, matched collectives)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from wtf_tpu.meshrun.executor import IMAGE_SPEC
+    from wtf_tpu.meshrun.mesh import LANE_AXIS
+
+    key = ("mesh", max_batches, n_pages, len_gpr, ptr_gpr, rounds,
+           deliver, mesh)
+    cached = _MEGA_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def any_fn(x):
+        return lax.pmax(jnp.any(x).astype(jnp.int32), LANE_AXIS) > 0
+
+    def sum_fn(ctr):
+        return lax.psum(jnp.sum(ctr.astype(jnp.uint64), axis=0),
+                        LANE_AXIS)
+
+    def merge_fn(agg_cov, agg_edge, cov, edge, include):
+        return mesh_merge_local(agg_cov, agg_edge, cov, edge, include,
+                                LANE_AXIS)
+
+    body = _make_body(max_batches, n_pages, len_gpr, ptr_gpr, rounds,
+                      deliver, merge_fn=merge_fn, any_fn=any_fn,
+                      sum_fn=sum_fn)
+    lane_snap = MegaSnap(words=P(LANE_AXIS), lens=P(LANE_AXIS))
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), IMAGE_SPEC, P(LANE_AXIS), P(LANE_AXIS),
+                  (P(), P(), P()), (P(), P(), P()), P(None, LANE_AXIS),
+                  P(), P(), P(), P(), P(), P(), P()),
+        out_specs=MegaOut(
+            machine=P(LANE_AXIS), agg_cov=P(), agg_edge=P(),
+            batches=P(), incomplete=P(), statuses=P(None, LANE_AXIS),
+            new_flags=P(None, LANE_AXIS), ctr_sums=P(), new_words=P(),
+            prev=lane_snap, cur=lane_snap),
+        check_rep=False))
+    _MEGA_CACHE[key] = fn
+    return fn

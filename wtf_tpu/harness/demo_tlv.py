@@ -125,7 +125,10 @@ TARGET = Target(
     create_mutator=_create_mutator,
     snapshot=build_snapshot,
     # declarative twin of _insert_testcase for the device-resident
-    # mutation path: bytes at INPUT_GVA, pointer in rsi (6), len in rdx (2)
+    # mutation path: bytes at INPUT_GVA, pointer in rsi (6), len in rdx
+    # (2); finish_gva is the stop bp _init plants (stop(Ok()) exactly),
+    # which lets the megachunk window retire clean lanes in-graph
     device_insert=DeviceInsertSpec(gva=INPUT_GVA, max_len=MAX_INPUT,
-                                   len_gpr=2, ptr_gpr=6),
+                                   len_gpr=2, ptr_gpr=6,
+                                   finish_gva=FINISH_GVA),
 )

@@ -49,6 +49,13 @@ class DeviceInsertSpec:
     max_len: int             # region capacity in bytes
     len_gpr: int = 2         # GPR index receiving the byte length (rdx)
     ptr_gpr: int = 6         # GPR index receiving the buffer GVA (rsi)
+    # Declarative stop breakpoint (the megachunk path, fuzz/megachunk.py):
+    # when set, the target PROMISES its init() arms exactly
+    # `set_breakpoint(finish_gva, lambda b: b.stop(Ok()))` at this rip,
+    # so the in-graph window may rewrite BREAKPOINT@finish_gva -> OK
+    # without a host round-trip.  Targets with richer handlers leave it
+    # None; their batches fall back to host breakpoint dispatch.
+    finish_gva: Optional[int] = None
 
 
 @dataclasses.dataclass

@@ -56,7 +56,18 @@ CTR_FUSED = 3        # instructions retired INSIDE the fused Pallas step
                      # kernel (interp/pstep.py); a subset of CTR_INSTR, so
                      # fused occupancy = CTR_FUSED / CTR_INSTR.  Stays 0
                      # on the plain XLA chunk path
-N_CTRS = 4
+CTR_PARK_SUBSET = 4  # fused-kernel park events for a SUBSET reason: a
+                     # non-hot opclass/operand form, an armed breakpoint,
+                     # or an SMC-risk code window (one count per park,
+                     # not per held step).  Stays 0 on the XLA path
+CTR_PARK_MEM = 5     # fused-kernel park events for a MEMORY reason: a
+                     # non-present/non-writable walk, an out-of-range
+                     # store frame, or overlay-slot exhaustion — the lane
+                     # leaves the kernel so the XLA leg can raise the
+                     # precise PAGE_FAULT/OVERLAY_FULL.  Distinct from
+                     # CTR_PARK_SUBSET so occupancy loss is attributable
+                     # (bench.py --fused-compare / telemetry_report)
+N_CTRS = 6
 
 
 class Machine(NamedTuple):

@@ -43,8 +43,8 @@ from wtf_tpu.cpu.interrupts import (
 )
 from wtf_tpu.interp import limbs
 from wtf_tpu.interp.machine import (
-    CTR_DECODE_MISS, CTR_FUSED, CTR_INSTR, CTR_MEM_FAULT, Machine,
-    machine_init, machine_restore,
+    CTR_DECODE_MISS, CTR_FUSED, CTR_INSTR, CTR_MEM_FAULT, CTR_PARK_MEM,
+    CTR_PARK_SUBSET, Machine, machine_init, machine_restore,
 )
 from wtf_tpu.interp.step import make_run_chunk
 from wtf_tpu.interp.uoptable import DecodeCache
@@ -501,29 +501,18 @@ _apply_page_writes_donated = partial(
 _apply_page_writes_plain = jax.jit(_apply_page_writes)
 
 
-@lru_cache(maxsize=None)
-def _make_device_insert(n_pages: int, n_words: int, len_gpr: int,
-                        ptr_gpr: int, donate: bool, masked: bool = False):
-    """The fused insert seam for device-generated testcases (wtf_tpu/
-    devmut): one in-graph update that lands a whole batch's bytes in the
-    per-lane overlay and sets the target ABI registers — the
-    mutate-on-device replacement for per-lane target.insert_testcase.
-
-    Claims n_pages FRESH overlay slots per lane starting at the lane's
-    current count, so rows the preceding host push allocated (init-time
-    target writes to pages OUTSIDE the insert region) survive.  Any
-    existing row already holding an insert-region pfn is retired first
-    (pfn -> -1): the testcase must win, and a duplicate-pfn row would
-    shadow the new one (overlay lookup takes the FIRST match).  A lane
-    without n_pages free slots surfaces as OVERLAY_FULL, exactly like
-    the host page-write path.  The u32 word stream bitcasts to the
-    overlay's u64 words at the pack seam; rows are fully valid (bytes
-    past the testcase length are zero by the engine's padded-slab
-    contract, so page contents are deterministic)."""
-    pad = n_pages * (PAGE_SIZE // 4) - n_words
-    assert pad >= 0, "testcase words exceed the insert region"
+def device_insert_impl(n_pages: int, len_gpr: int, ptr_gpr: int,
+                       masked: bool = False):
+    """The PURE insert transition (machine, words, lens, pfns, gva_l[,
+    active]) -> machine' for a given insert-region geometry — shared by
+    the jitted standalone seam below and the megachunk program
+    (wtf_tpu/fuzz/megachunk.py), so the two dispatch paths cannot drift.
+    See `_make_device_insert` for the slot-claim contract."""
 
     def impl(machine: Machine, words, lens, pfns, gva_l, *rest):
+        n_words = words.shape[1]
+        pad = n_pages * (PAGE_SIZE // 4) - n_words
+        assert pad >= 0, "testcase words exceed the insert region"
         # `masked` variant (wtf_tpu/tenancy): `active` (bool[L]) limits
         # the insert to one tenant's lanes — inactive lanes keep their
         # overlay rows, counters, status and ABI registers untouched, so
@@ -585,6 +574,34 @@ def _make_device_insert(n_pages: int, n_words: int, len_gpr: int,
         return machine._replace(overlay=overlay, gpr_l=gpr,
                                 status=status)
 
+    return impl
+
+
+@lru_cache(maxsize=None)
+def _make_device_insert(n_pages: int, n_words: int, len_gpr: int,
+                        ptr_gpr: int, donate: bool, masked: bool = False):
+    """The fused insert seam for device-generated testcases (wtf_tpu/
+    devmut): one in-graph update that lands a whole batch's bytes in the
+    per-lane overlay and sets the target ABI registers — the
+    mutate-on-device replacement for per-lane target.insert_testcase.
+
+    Claims n_pages FRESH overlay slots per lane starting at the lane's
+    current count, so rows the preceding host push allocated (init-time
+    target writes to pages OUTSIDE the insert region) survive.  Any
+    existing row already holding an insert-region pfn is retired first
+    (pfn -> -1): the testcase must win, and a duplicate-pfn row would
+    shadow the new one (overlay lookup takes the FIRST match).  A lane
+    without n_pages free slots surfaces as OVERLAY_FULL, exactly like
+    the host page-write path.  The u32 word stream bitcasts to the
+    overlay's u64 words at the pack seam; rows are fully valid (bytes
+    past the testcase length are zero by the engine's padded-slab
+    contract, so page contents are deterministic).
+
+    `n_words` only keys the memoization (jit re-specializes on shapes);
+    the transition itself comes from `device_insert_impl`, the same
+    pure function the megachunk program inlines."""
+    del n_words
+    impl = device_insert_impl(n_pages, len_gpr, ptr_gpr, masked=masked)
     return jax.jit(impl, donate_argnums=(0,) if donate else ())
 
 
@@ -781,6 +798,23 @@ class Runner:
         return (make_run_fused(self.fused_k),
                 make_run_resume(self.fused_resume_steps,
                                 donate=self._donate))
+
+    def megachunk_callable(self, max_batches: int, n_pages: int,
+                           len_gpr: int, ptr_gpr: int, rounds: int):
+        """The one-dispatch multi-batch window program (wtf_tpu/fuzz/
+        megachunk.py) — the seam the megachunk driver dispatches, so
+        mesh runners can swap in the shard_map variant with the same
+        signature."""
+        from wtf_tpu.fuzz.megachunk import make_megachunk
+
+        return make_megachunk(max_batches, n_pages, len_gpr, ptr_gpr,
+                              rounds, deliver=self.deliver_exceptions)
+
+    def megachunk_place(self, slab_first, slab_rest, seeds):
+        """Placement hook for one window's operands — identity on a
+        single device; the mesh runner replicates the slabs and shards
+        the seed stream."""
+        return slab_first, slab_rest, seeds
 
     def devmut_generate(self, rounds: int, data, lens, cumw, seeds):
         """Dispatch one devmut batch generation (wtf_tpu/devmut) — the
@@ -1476,13 +1510,11 @@ class Runner:
         a copy, never a view (donation note in run())."""
         return np.array(jax.device_get(self.machine.ctr))
 
-    def fold_device_counters(self) -> np.ndarray:
-        """Pull the counter block ONCE per burst and add the batch totals
-        into the registry (`device.*` counters) — the host-side fold that
-        replaces any per-step sync.  Call between run() and restore();
-        returns the per-lane block for callers that want lane detail."""
-        ctr = self.device_counters()
-        totals = ctr.sum(axis=0, dtype=np.uint64)
+    def fold_counter_totals(self, totals) -> None:
+        """Add one [N_CTRS] totals vector into the registry's `device.*`
+        counters — shared by the per-burst fold below and the megachunk
+        driver (whose in-graph restores zero the per-lane block between
+        batches, so the program emits per-batch totals instead)."""
         reg = self.registry
         reg.counter("device.instructions").inc(int(totals[CTR_INSTR]))
         reg.counter("device.mem_faults").inc(int(totals[CTR_MEM_FAULT]))
@@ -1490,6 +1522,22 @@ class Runner:
         # instructions retired inside the fused Pallas kernel (a subset of
         # device.instructions; their ratio is the fused-step occupancy)
         reg.counter("device.fused_steps").inc(int(totals[CTR_FUSED]))
+        # park-reason split (interp/pstep.py): SUBSET = non-hot opclass /
+        # armed bp / SMC-risk code; MEM = a lane the kernel WOULD have
+        # run that the memory path diverted (failing walk, unwritable
+        # store, overlay exhaustion).  One number used to hide why lanes
+        # leave the kernel; these two make occupancy loss attributable.
+        reg.counter("device.fused_park_subset").inc(
+            int(totals[CTR_PARK_SUBSET]))
+        reg.counter("device.fused_park_mem").inc(int(totals[CTR_PARK_MEM]))
+
+    def fold_device_counters(self) -> np.ndarray:
+        """Pull the counter block ONCE per burst and add the batch totals
+        into the registry (`device.*` counters) — the host-side fold that
+        replaces any per-step sync.  Call between run() and restore();
+        returns the per-lane block for callers that want lane detail."""
+        ctr = self.device_counters()
+        self.fold_counter_totals(ctr.sum(axis=0, dtype=np.uint64))
         return ctr
 
 

@@ -1181,12 +1181,23 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     cp_leaf0 = (cpuid_keys[:, 0] == cp_eax) & (cpuid_keys[:, 1] == 0)
     cp_in_basic_fb = ((cp_eax < jnp.uint32(0x80000000))
                       & (cp_eax > jnp.uint32(MAX_BASIC_LEAF)))
-    cp_row = jnp.where(jnp.any(cp_exact), jnp.argmax(cp_exact),
-                       jnp.where(jnp.any(cp_leaf0), jnp.argmax(cp_leaf0),
-                                 _CPUID_BASIC_ROW))
-    cp_found = jnp.any(cp_exact) | jnp.any(cp_leaf0) | cp_in_basic_fb
-    cpuid_out = jnp.where(cp_found, cpuid_vals[cp_row],
-                          jnp.zeros(4, jnp.uint32)).astype(jnp.uint64)
+    # Masked-sum row selection instead of a dynamic-slice gather of the
+    # matching row: CPUID_TABLE keys are unique so at most one row
+    # matches each mask and the sum IS that row; the basic-leaf fallback
+    # row is a static index, so it constant-folds.  One fewer
+    # data-dependent kernel in the compiled ladder (budgets.json).
+    cp_exact_row = jnp.sum(
+        jnp.where(cp_exact[:, None], cpuid_vals, jnp.uint32(0)), axis=0,
+        dtype=jnp.uint32)
+    cp_leaf0_row = jnp.sum(
+        jnp.where(cp_leaf0[:, None], cpuid_vals, jnp.uint32(0)), axis=0,
+        dtype=jnp.uint32)
+    cp_basic_row = jnp.asarray(_CPUID_VALS[_CPUID_BASIC_ROW])
+    cpuid_out = jnp.where(
+        jnp.any(cp_exact), cp_exact_row,
+        jnp.where(jnp.any(cp_leaf0), cp_leaf0_row,
+                  jnp.where(cp_in_basic_fb, cp_basic_row,
+                            jnp.zeros(4, jnp.uint32)))).astype(jnp.uint64)
 
     # RDTSC / RDRAND / XGETBV / SYSCALL / SWAPGS / MOVCR ---------------
     tsc_now = st.tsc + st.icount
@@ -1612,8 +1623,11 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
 
     x_ph0 = _xphys(_u(0))
     x_phi = _xphys(x_i)
-    st0_b = fpst_v[x_ph0]
-    sti_b = fpst_v[x_phi]
+    # one two-row gather instead of two scalar gathers (kernel-count
+    # currency: the step wall tracks gather-class kernels, not bytes)
+    x_st_pair = fpst_v[jnp.stack([x_ph0, x_phi])]
+    st0_b = x_st_pair[0]
+    sti_b = x_st_pair[1]
     st0_f = lax.bitcast_convert_type(st0_b, jnp.float64)
 
     # memory operand -> f64 bits: m64 is a raw bit move, m32 converts
@@ -2159,8 +2173,9 @@ def step_lane(tab: UopTable, image: MemImage, st: Machine, limit) -> Machine:
     # per-step host sync this exists to avoid.  page_fault/miss already
     # imply `enabled`, commit implies `live`.  CTR_FUSED stays untouched
     # here: only the fused Pallas kernel (interp/pstep.py) retires into it.
+    _f = jnp.bool_(False)
     new_ctr = st.ctr + jnp.stack(
-        [commit, page_fault, miss, jnp.bool_(False)]).astype(jnp.uint32)
+        [commit, page_fault, miss, _f, _f, _f]).astype(jnp.uint32)
     timed = commit & (limit > _u(0)) & (new_icount >= limit)
     new_rdrand = jnp.where(commit & is_(U.OPC_RDRAND), rdrand_next, st.rdrand)
     new_bp_skip = jnp.where(commit, jnp.int32(0), st.bp_skip)

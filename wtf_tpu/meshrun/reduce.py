@@ -163,6 +163,34 @@ def first_hit_credit(agg_cov, agg_edge, cov, edge, include):
 _MESH_MERGE_CACHE: dict = {}
 
 
+def mesh_merge_local(agg_cov, agg_edge, cov, edge, include,
+                     axis_name: str = LANE_AXIS):
+    """The per-shard body of the mesh batch merge — module-level so the
+    megachunk program (wtf_tpu/fuzz/megachunk.py) can inline the SAME
+    merge inside its per-batch loop: shard-local prefix credit via
+    `_merge_core`, one all_gather of the tiny per-shard unions for the
+    cross-shard exclusive prefix.  Bit-identical to `merge_coverage`
+    for any lane order."""
+    inc = include[:, None]
+    cov_in = jnp.where(inc, cov, 0)
+    edge_in = jnp.where(inc, edge, 0)
+    wc = cov.shape[1]
+    zc = jnp.zeros_like(agg_cov)
+    ze = jnp.zeros_like(agg_edge)
+    uc, ue, _ = _merge_core(agg_cov, agg_edge, cov_in, edge_in, zc, ze)
+    allu = lax.all_gather(jnp.concatenate([uc, ue]), axis_name)
+    sidx = lax.axis_index(axis_name)
+    nshards = allu.shape[0]
+    lower = jnp.where((jnp.arange(nshards) < sidx)[:, None], allu, 0)
+    prev = jnp.bitwise_or.reduce(lower, axis=0)
+    union = jnp.bitwise_or.reduce(allu, axis=0)
+    _, _, new_lane = _merge_core(
+        agg_cov, agg_edge, cov_in, edge_in, prev[:wc], prev[wc:])
+    new_cov_words = union[:wc] & ~agg_cov
+    return (agg_cov | union[:wc], agg_edge | union[wc:],
+            new_lane & include, new_cov_words)
+
+
 def make_mesh_merge(mesh):
     """The batch merge over a lane-sharded machine: per shard, the SAME
     `_merge_core` runs on the local lane block; the cross-shard exclusive
@@ -178,29 +206,8 @@ def make_mesh_merge(mesh):
     cached = _MESH_MERGE_CACHE.get(key)
     if cached is not None:
         return cached
-
-    def local(agg_cov, agg_edge, cov, edge, include):
-        inc = include[:, None]
-        cov_in = jnp.where(inc, cov, 0)
-        edge_in = jnp.where(inc, edge, 0)
-        wc = cov.shape[1]
-        zc = jnp.zeros_like(agg_cov)
-        ze = jnp.zeros_like(agg_edge)
-        uc, ue, _ = _merge_core(agg_cov, agg_edge, cov_in, edge_in, zc, ze)
-        allu = lax.all_gather(jnp.concatenate([uc, ue]), LANE_AXIS)
-        sidx = lax.axis_index(LANE_AXIS)
-        nshards = allu.shape[0]
-        lower = jnp.where((jnp.arange(nshards) < sidx)[:, None], allu, 0)
-        prev = jnp.bitwise_or.reduce(lower, axis=0)
-        union = jnp.bitwise_or.reduce(allu, axis=0)
-        _, _, new_lane = _merge_core(
-            agg_cov, agg_edge, cov_in, edge_in, prev[:wc], prev[wc:])
-        new_cov_words = union[:wc] & ~agg_cov
-        return (agg_cov | union[:wc], agg_edge | union[wc:],
-                new_lane & include, new_cov_words)
-
     fn = jax.jit(shard_map(
-        local, mesh=mesh,
+        mesh_merge_local, mesh=mesh,
         in_specs=(P(), P(), P(LANE_AXIS), P(LANE_AXIS), P(LANE_AXIS)),
         out_specs=(P(), P(), P(LANE_AXIS), P()),
         check_rep=False))

@@ -131,6 +131,34 @@ class MeshRunner(Runner):
 
         return fused, dispatch_resume
 
+    def megachunk_callable(self, max_batches: int, n_pages: int,
+                           len_gpr: int, ptr_gpr: int, rounds: int):
+        """The megachunk window per shard (fuzz/megachunk.py mesh
+        variant): slabs/seeds arrive pre-placed through the driver's
+        megachunk_operands; outputs keep canonical shardings."""
+        from wtf_tpu.fuzz.megachunk import make_mesh_megachunk
+
+        return make_mesh_megachunk(max_batches, n_pages, len_gpr,
+                                   ptr_gpr, rounds,
+                                   deliver=self.deliver_exceptions,
+                                   mesh=self.mesh)
+
+    def megachunk_place(self, slab_first, slab_rest, seeds):
+        """Place one window's operands: slabs replicated (version-
+        tracked like devmut_generate's), the seed stream lane-sharded."""
+        rep = replicated_sharding(self.mesh)
+        if slab_rest[0] is not self._slab_src:
+            self._slab_src = slab_rest[0]
+            self._slab_repl = tuple(
+                jax.device_put(a, rep) for a in slab_rest)
+        rest = self._slab_repl
+        first = (rest if slab_first[0] is slab_rest[0]
+                 else tuple(jax.device_put(a, rep) for a in slab_first))
+        seeds = jax.device_put(jnp.asarray(seeds),
+                               jax.sharding.NamedSharding(
+                                   self.mesh, P(None, LANE_AXIS)))
+        return first, rest, seeds
+
     # -- host write seams: keep the canonical sharding -----------------------
     def push(self, view) -> None:
         super().push(view)

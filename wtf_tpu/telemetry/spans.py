@@ -32,6 +32,20 @@ from wtf_tpu.telemetry.metrics import Registry
 SECONDS = "phase.seconds"
 CALLS = "phase.calls"
 
+# Span leaves that measure DEVICE work (each is fenced with
+# jax.block_until_ready before its span closes): the device-step/
+# pallas-step executors, the fused devmut generation / insert /
+# megachunk-window waits ("device" under mutate/insert/execute), the
+# overlay restore, and the coverage readback.  Everything else inside a
+# top-level phase is host time.  The ONE list the host/device wall
+# breakdown rides on — tools/telemetry_report.py and ablate.py's
+# host-share A/B both consume it, so the split cannot drift between
+# the report and the benchmark.
+DEVICE_SPAN_LEAVES = frozenset((
+    "device", "device-step", "pallas-step", "overlay-restore",
+    "cov-readback",
+))
+
 
 def block_until_ready(value) -> None:
     """Fence: wait until every device array in `value` has materialized.

@@ -1,0 +1,109 @@
+"""Fused-step + megachunk smoke (`make fused-smoke`, wired into
+`make verify`).
+
+A tiny end-to-end pass over PR 12's two fused layers, CPU-only, no
+hardware:
+
+  occupancy   the widened Pallas kernel (interp/pstep.py: in-kernel page
+              walk + delta-overlay probe + memory-operand/stack forms)
+              must keep >= 0.95 of demo_tlv's retired instructions
+              in-kernel under interpret mode at small lanes — the
+              ISSUE-14 acceptance bar, measured from the device counter
+              block (CTR_FUSED / CTR_INSTR), with the park split
+              reported so a regression names its reason;
+  megachunk   a short devmangle campaign through one-dispatch
+              multi-batch windows (wtf_tpu/fuzz/megachunk.py) must be
+              bit-identical to the batch-at-a-time device loop at equal
+              seeds — aggregate coverage/edge bitmap bytes, corpus
+              digests, crash buckets, every counter.
+
+Exit 0 = all held; any assertion prints and exits 1.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def _occupancy_leg() -> None:
+    from wtf_tpu.harness import demo_tlv
+    from wtf_tpu.interp.machine import (
+        CTR_FUSED, CTR_INSTR, CTR_PARK_MEM, CTR_PARK_SUBSET,
+    )
+    from wtf_tpu.interp.runner import Runner, warm_decode_cache
+
+    payload = b"\x01\x08AAAAAAAA" * 50
+    r = Runner(demo_tlv.build_snapshot(), n_lanes=2, chunk_steps=64,
+               fused_step="on")
+    r.limit = 4_000
+    warm_decode_cache(r, demo_tlv.TARGET, payload)
+    view = r.view()
+    for lane in range(2):
+        view.virt_write(lane, demo_tlv.INPUT_GVA, payload)
+        view.r["gpr"][lane, 2] = np.uint64(len(payload))
+    r.push(view)
+    r.run()
+    ctr = np.asarray(r.machine.ctr)
+    instr = int(ctr[:, CTR_INSTR].sum(dtype=np.uint64))
+    fused = int(ctr[:, CTR_FUSED].sum(dtype=np.uint64))
+    occ = fused / max(instr, 1)
+    print(f"[fused-smoke] occupancy {occ:.4f} "
+          f"({fused}/{instr} in-kernel; parks "
+          f"subset={int(ctr[:, CTR_PARK_SUBSET].sum())} "
+          f"mem={int(ctr[:, CTR_PARK_MEM].sum())})")
+    assert instr > 1000, "demo_tlv hot loop barely ran"
+    assert occ >= 0.95, (
+        f"fused occupancy {occ:.4f} < 0.95 — the memory subset "
+        f"regressed out of the kernel (check the park split above)")
+
+
+def _megachunk_leg() -> None:
+    import jax
+
+    from wtf_tpu.analysis.trace import build_tlv_campaign
+    from wtf_tpu.utils.hashing import hex_digest
+
+    def campaign(mega):
+        loop = build_tlv_campaign(
+            mutator="devmangle", seed=0x5EED, megachunk=mega, n_lanes=4,
+            limit=10_000, chunk_steps=128, overlay_slots=16)
+        # 8 batches, not a cold-cache handful: finds must land in
+        # IN-GRAPH batches so the find-stop slab schedule (the seam
+        # where parity can skew) is actually exercised
+        loop.fuzz(runs=4 * 8)
+        cov, edge = loop.backend.coverage_state()
+        return {
+            "cov": cov.tobytes(), "edge": edge.tobytes(),
+            "corpus": [hex_digest(d) for d in loop.corpus],
+            "buckets": sorted(loop.crash_buckets),
+            "testcases": loop.stats.testcases,
+            "crashes": loop.stats.crashes,
+            "timeouts": loop.stats.timeouts,
+        }
+
+    legacy = campaign(0)
+    windowed = campaign(3)
+    for key in legacy:
+        assert windowed[key] == legacy[key], (
+            f"megachunk diverged from the batch-at-a-time loop on {key}")
+    print(f"[fused-smoke] megachunk parity held "
+          f"({legacy['testcases']} testcases, "
+          f"{legacy['crashes']} crashes, "
+          f"{len(legacy['corpus'])} corpus entries)")
+
+
+def main() -> int:
+    try:
+        _occupancy_leg()
+        _megachunk_leg()
+    except AssertionError as e:
+        print(f"[fused-smoke] FAILED: {e}")
+        return 1
+    print("[fused-smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
