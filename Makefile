@@ -7,10 +7,11 @@
 PY ?= python
 
 .PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke \
-	triage-smoke tenancy-smoke fleet-smoke fused-smoke
+	triage-smoke tenancy-smoke fleet-smoke fused-smoke \
+	device-chaos-smoke
 
 verify: test lint chaos-smoke triage-smoke tenancy-smoke fleet-smoke \
-	fused-smoke
+	fused-smoke device-chaos-smoke
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -77,3 +78,11 @@ fused-smoke:
 # lost testcases, bit-identical kill/resume parity.  Exit 0 = all held.
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.chaos_smoke
+
+# self-healing device runtime soak (wtf_tpu/testing/device_chaos_smoke):
+# scripted device hang/error/poison against the supervised dispatch
+# seams — >=1 watchdog fire, >=1 ladder degradation + re-promotion,
+# >=1 quarantined lane, and every recovery bit-identical to the
+# fault-free run (coverage, edge bytes, corpus digests, crash buckets)
+device-chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.device_chaos_smoke

@@ -239,6 +239,51 @@ def summarize(path) -> dict:
             resilience["checkpoint_mean_seconds"] = round(
                 sum(checkpoint_secs) / len(checkpoint_secs), 4)
 
+    # device resilience (wtf_tpu/supervise): what the self-healing
+    # runtime did — watchdog fires, device errors, backend rebuilds,
+    # batch replays, ladder movement, quarantined lanes — plus what the
+    # always-on machinery cost (snapshot + integrity + recovery span
+    # seconds against wall).  None when the run was unsupervised.
+    device_res = None
+    sup_signals = {
+        "supervised_dispatches": metrics.get("supervise.dispatches", 0) or 0,
+        "watchdog_fires": metrics.get("supervise.watchdog_fires", 0) or 0,
+        "device_errors": metrics.get("supervise.device_errors", 0) or 0,
+        "rebuilds": metrics.get("supervise.rebuilds", 0) or 0,
+        "batch_retries": metrics.get("supervise.batch_retries", 0) or 0,
+        "degradations": metrics.get("supervise.degradations", 0) or 0,
+        "promotions": metrics.get("supervise.promotions", 0) or 0,
+        "poisoned_lanes": metrics.get("supervise.poisoned_lanes", 0) or 0,
+        "quarantined_total": metrics.get("device.quarantined", 0) or 0,
+        "integrity_checks": metrics.get("supervise.integrity_checks",
+                                        0) or 0,
+    }
+    if any(sup_signals.values()):
+        device_res = dict(sup_signals)
+        # gauges: final rung index (0 = full speed) and lanes still
+        # quarantined at dump time (vs the lifetime quarantined_total)
+        device_res["final_rung"] = metrics.get("supervise.rung", 0) or 0
+        device_res["quarantined_now"] = metrics.get(
+            "supervise.quarantined_lanes", 0) or 0
+        # supervisor cost: snapshot/integrity/recover spans wherever they
+        # nest in the phase tree.  overhead_share folds in only the
+        # steady-state legs (snapshot + integrity); recovery seconds are
+        # fault-path work and reported separately.
+        sup_leaves = {"integrity": 0.0, "supervise-snapshot": 0.0,
+                      "supervise-recover": 0.0}
+        for span_path, secs in phase_seconds.items():
+            leaf = span_path.split("/")[-1]
+            if leaf in sup_leaves:
+                sup_leaves[leaf] += secs
+        device_res["integrity_seconds"] = round(sup_leaves["integrity"], 4)
+        device_res["snapshot_seconds"] = round(
+            sup_leaves["supervise-snapshot"], 4)
+        device_res["recover_seconds"] = round(
+            sup_leaves["supervise-recover"], 4)
+        steady = sup_leaves["integrity"] + sup_leaves["supervise-snapshot"]
+        device_res["overhead_share"] = (round(steady / wall, 4)
+                                        if wall else None)
+
     # fleet (distribution tier): streaming-delta wire savings, store
     # dedup activity, crash bucket-dedup rate, elastic reshards.  None
     # when the run produced no fleet signal.
@@ -334,6 +379,7 @@ def summarize(path) -> dict:
         "triage": triage,
         "tenants": tenants,
         "resilience": resilience,
+        "device_resilience": device_res,
         "fleet": fleet,
         "errors": errors,
     }
@@ -458,6 +504,24 @@ def _print_human(s: dict) -> None:
               f"reconnects={res['reconnects']} "
               f"reclaimed={res['reclaimed_testcases']} "
               f"resumes={res['resumes']} drains={res['drains']}{ckpt}")
+    dres = s.get("device_resilience")
+    if dres:
+        share = (f"{dres['overhead_share'] * 100:.2f}%"
+                 if dres.get("overhead_share") is not None else "n/a")
+        print(f"device resilience: rung={dres['final_rung']} "
+              f"watchdog={dres['watchdog_fires']} "
+              f"errors={dres['device_errors']} "
+              f"rebuilds={dres['rebuilds']} "
+              f"retries={dres['batch_retries']} "
+              f"ladder={dres['degradations']}v/{dres['promotions']}^ "
+              f"quarantined={dres['quarantined_now']} "
+              f"(lifetime {dres['quarantined_total']}, "
+              f"poison events {dres['poisoned_lanes']})")
+        print(f"  supervisor cost: {share} of wall steady-state "
+              f"(integrity {dres['integrity_seconds']}s over "
+              f"{dres['integrity_checks']} checks, "
+              f"snapshot {dres['snapshot_seconds']}s) "
+              f"+ recovery {dres['recover_seconds']}s")
     flt = s.get("fleet")
     if flt:
         ratio = (f"{flt['delta_ratio']}x"

@@ -14,7 +14,8 @@ Entry points:
     wtf-tpu lint ...                    (installed console script)
 
 Rule families (wtf_tpu/analysis/rules.py): dtype, budget, recompile,
-parity.  Budgets live in wtf_tpu/analysis/budgets.json; re-baseline with
+parity, mesh, supervise.  Budgets live in wtf_tpu/analysis/budgets.json;
+re-baseline with
 `--rebaseline` when a PR legitimately changes kernel count (PERF.md
 round 9 documents the procedure).
 """
@@ -32,8 +33,10 @@ from wtf_tpu.analysis.parity import check_fused_parity  # noqa: F401
 from wtf_tpu.analysis.rules import (  # noqa: F401
     FAMILIES, apply_rebaseline, check_budget, check_mesh_collectives,
     check_no_u64,
-    check_seam_bitcast_only, check_shard_stability, check_signature_stable,
-    check_strong_inputs, count_collective_ops, count_data_dependent_ops,
+    check_seam_bitcast_only, check_seam_enumeration, check_shard_stability,
+    check_signature_stable,
+    check_strong_inputs, check_supervised_seams, count_collective_ops,
+    count_data_dependent_ops,
     run_dtype_family, run_lint, run_mesh_family,
 )
 

@@ -99,7 +99,7 @@ MESH_DEVICES = 8
 MESH_CONFIG = dict(n_steps=16, lanes_per_shard=2,
                    uop_capacity=1 << 10, overlay_slots=8, edge_bits=12)
 
-FAMILIES = ("dtype", "budget", "recompile", "parity", "mesh")
+FAMILIES = ("dtype", "budget", "recompile", "parity", "mesh", "supervise")
 
 _FORBID_64 = re.compile(r"\b(u64|s64|f64|f32)\[")
 # jaxpr primitives that move/reshape bits without computing on them (the
@@ -387,6 +387,76 @@ def check_triage_chunk() -> List[Finding]:
                      "executors (budget + mesh census coverage), not a "
                      "private program")))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# supervise family
+# ---------------------------------------------------------------------------
+
+def check_supervised_seams(sites: Optional[Dict[str, str]] = None
+                           ) -> List[Finding]:
+    """Every device dispatch entry point must route through the
+    supervisor (wtf_tpu/supervise) — the recovery/watchdog/chaos
+    contract is only as strong as its seam coverage, so the enumeration
+    is an export hook (supervise.SEAM_SITES, the PORTED_LIMB_PATHS
+    mechanism): a new dispatch seam must be listed there AND its listed
+    site must contain the literal `supervisor.dispatch("<seam>"...)`
+    routing call.  Statically, by source inspection — the seams include
+    paths (mesh, fused) a CPU lint run never executes.  `sites`
+    parameterizes the enumeration for rule tests."""
+    import importlib
+    import inspect
+
+    if sites is None:
+        from wtf_tpu.supervise import SEAM_SITES
+
+        sites = SEAM_SITES
+    findings: List[Finding] = []
+    dispatch_re = re.compile(r"supervisor\s*\.\s*dispatch\(")
+    for seam, site in sorted(sites.items()):
+        mod_name, _, qual = site.partition(":")
+        try:
+            obj = importlib.import_module(mod_name)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            src = inspect.getsource(obj)
+        except Exception as e:  # unresolvable site IS the finding
+            findings.append(Finding(
+                rule="supervise.seam-routing", entry=site, primitive=seam,
+                message=(f"supervised seam site unresolvable ({e}) — "
+                         "supervise.SEAM_SITES must name the live "
+                         "module:Class.method of every dispatch seam")))
+            continue
+        if not (dispatch_re.search(src) and f'"{seam}"' in src):
+            findings.append(Finding(
+                rule="supervise.seam-routing", entry=site, primitive=seam,
+                message=(f"dispatch seam {seam!r} does not route through "
+                         "Supervisor.dispatch — a hang/error/poison here "
+                         "would bypass watchdog + rebuild-and-replay "
+                         "recovery; route the call or update "
+                         "supervise.SEAM_SITES")))
+    return findings
+
+
+def check_seam_enumeration() -> List[Finding]:
+    """Completeness of the export hook itself: the known dispatch-seam
+    surface (the Runner seam methods MeshRunner re-points, the megachunk
+    window, devmut generate) must each be claimed by some SEAM_SITES
+    entry — deleting a seam's enumeration to dodge the routing rule is
+    itself a finding."""
+    from wtf_tpu.supervise import SEAM_SITES
+
+    claimed = set(SEAM_SITES)
+    required = {"chunk", "fused", "fused-resume", "device-insert",
+                "devmut-generate", "megachunk"}
+    missing = sorted(required - claimed)
+    return [Finding(
+        rule="supervise.seam-enumeration", entry="supervise.SEAM_SITES",
+        primitive=seam,
+        message=(f"dispatch seam {seam!r} dropped from "
+                 "supervise.SEAM_SITES — the routing rule no longer "
+                 "covers it"))
+        for seam in missing]
 
 
 def _first_diff_line(text_a: str, text_b: str) -> Tuple[int, str]:
@@ -930,6 +1000,16 @@ def run_lint(families: Optional[Sequence[str]] = None,
         if mesh_info.get("entry"):
             info["entries"].append(mesh_info["entry"])
         info["seconds"]["mesh"] = round(time.time() - t0, 1)
+
+    if "supervise" in families:
+        t0 = time.time()
+        findings.extend(check_supervised_seams())
+        findings.extend(check_seam_enumeration())
+        from wtf_tpu.supervise import SEAM_SITES
+
+        info["entries"].append(
+            f"supervise.SEAM_SITES ({len(SEAM_SITES)} seams)")
+        info["seconds"]["supervise"] = round(time.time() - t0, 1)
 
     if rebaseline and measured_budgets:
         budgets = apply_rebaseline(load_budgets(budgets_path),

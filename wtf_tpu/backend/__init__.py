@@ -28,6 +28,11 @@ def create_backend(name: str, snapshot, **kwargs) -> Backend:
     if name == "emu":
         kwargs.pop("n_lanes", None)
         kwargs.pop("mesh_devices", None)
+        # supervision guards DEVICE dispatch seams; the pure-host oracle
+        # backend has none
+        for key in ("supervise", "dispatch_timeout", "promote_after",
+                    "max_batch_retries", "quarantine_threshold"):
+            kwargs.pop(key, None)
         return EmuBackend(snapshot, **kwargs)
     if name == "tpu":
         if kwargs.get("mesh_devices") is not None:

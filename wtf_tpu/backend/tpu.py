@@ -60,6 +60,23 @@ class TpuBackend(Backend):
         # nest and the heartbeat dump carries everything
         self.registry, self.events = telemetry.resolve(
             registry=registry, events=events)
+        # Self-healing device runtime (wtf_tpu/supervise): the supervisor
+        # outlives the Runner it guards — initialize() hands it to every
+        # (re)built Runner so dispatch indices, ladder state and the
+        # quarantine set survive recovery rebuilds.
+        from wtf_tpu.supervise import Supervisor
+
+        self.supervisor = Supervisor(
+            registry=self.registry, events=self.events,
+            enabled=bool(runner_kwargs.pop("supervise", False)),
+            dispatch_timeout=float(
+                runner_kwargs.pop("dispatch_timeout", 0.0) or 0.0),
+            promote_after=int(runner_kwargs.pop("promote_after", 8)),
+            max_batch_retries=int(
+                runner_kwargs.pop("max_batch_retries", 4)),
+            quarantine_threshold=int(
+                runner_kwargs.pop("quarantine_threshold", 3)))
+        self.supervisor._backend = self
         self._runner_kwargs = runner_kwargs
         self.runner: Optional[Runner] = None
         self.breakpoints: Dict[int, BreakpointHandler] = {}
@@ -83,6 +100,7 @@ class TpuBackend(Backend):
     def initialize(self) -> None:
         self.runner = Runner(self.snapshot, self.n_lanes,
                              registry=self.registry, events=self.events,
+                             supervisor=self.supervisor,
                              **self._runner_kwargs)
         m = self.runner.machine
         self._agg_cov = jnp.zeros_like(m.cov[0])
@@ -133,7 +151,14 @@ class TpuBackend(Backend):
             n_active = self.n_lanes
             if insert is not None:
                 n_active = len(insert)
+                quarantined = runner.supervisor.quarantined
                 for lane, data in enumerate(insert):
+                    if lane in quarantined:
+                        # poisoned lane parked idle (tenancy mask idiom):
+                        # no insert, terminal status, and _finish_batch's
+                        # include mask keeps it out of the coverage merge
+                        view.set_status(lane, StatusCode.OK)
+                        continue
                     with self._bound(view, lane):
                         target.insert_testcase(self, data)
                 for lane in range(n_active, self.n_lanes):
@@ -152,12 +177,21 @@ class TpuBackend(Backend):
         on truncated memory, their coverage is not trustworthy), backend
         counters, and the once-per-burst device-counter fold."""
         runner = self.runner
+        # integrity gate BEFORE anything consumes the machine: a poisoned
+        # status would crash StatusCode() in result mapping, poisoned
+        # planes would credit coverage.  Raises LanePoisoned (the fuzz
+        # loop's supervision wrapper replays the batch); inert when the
+        # supervisor is disabled.
+        runner.supervisor.raise_if_poisoned(runner, "batch")
+        qmask = runner.supervisor.quarantine_mask()
         with self.registry.spans.span("cov-readback") as sp:
             m = runner.machine
-            include = jnp.asarray(
-                (statuses != int(StatusCode.TIMEDOUT))
-                & (statuses != int(StatusCode.OVERLAY_FULL))
-                & (np.arange(self.n_lanes) < n_active))
+            keep = ((statuses != int(StatusCode.TIMEDOUT))
+                    & (statuses != int(StatusCode.OVERLAY_FULL))
+                    & (np.arange(self.n_lanes) < n_active))
+            if qmask is not None:
+                keep &= ~qmask  # quarantined lanes never credit coverage
+            include = jnp.asarray(keep)
             (self._agg_cov, self._agg_edge, new_lane,
              new_words) = self._merge(
                 self._agg_cov, self._agg_edge, m.cov, m.edge, include)
@@ -203,9 +237,21 @@ class TpuBackend(Backend):
             if self._view is not None:
                 runner.push(self._view)
                 self._view = None
+            qmask = runner.supervisor.quarantine_mask()
             with spans.span("device") as sp:
-                runner.device_insert(words, lens, pfns, spec.gva,
-                                     spec.len_gpr, spec.ptr_gpr)
+                if qmask is None:
+                    runner.device_insert(words, lens, pfns, spec.gva,
+                                         spec.len_gpr, spec.ptr_gpr)
+                else:
+                    # masked insert (tenancy idiom) + park the poisoned
+                    # lanes terminal so the run loop never steps them
+                    from wtf_tpu.supervise import integrity
+
+                    runner.device_insert(words, lens, pfns, spec.gva,
+                                         spec.len_gpr, spec.ptr_gpr,
+                                         active=~qmask)
+                    runner.machine = integrity.mask_idle(
+                        runner.machine, qmask)
                 sp.fence(runner.machine.status)
         statuses = runner.run(bp_handler=self._dispatch_bp)
         self._finish_batch(statuses, self.n_lanes)
@@ -272,14 +318,21 @@ class TpuBackend(Backend):
             [spec.gva & 0xFFFF_FFFF, (spec.gva >> 32) & 0xFFFF_FFFF],
             dtype=np.uint32))
         with spans.span("device") as sp:
-            out = fn(runner.device_tab(), runner.image, runner.machine,
-                     runner.template, slab_first, slab_rest, seeds, pfns,
-                     gva_l, jnp.uint64(finish), jnp.uint64(self.limit),
-                     jnp.int32(n_batches), self._agg_cov, self._agg_edge)
+            out = runner.supervisor.dispatch(
+                "megachunk", fn,
+                runner.device_tab(), runner.image, runner.machine,
+                runner.template, slab_first, slab_rest, seeds, pfns,
+                gva_l, jnp.uint64(finish), jnp.uint64(self.limit),
+                jnp.int32(n_batches), self._agg_cov, self._agg_edge,
+                window=n_batches, sync=lambda o: o.batches)
             sp.fence(out.batches)
         runner.machine = out.machine
         self._agg_cov = out.agg_cov
         self._agg_edge = out.agg_edge
+        # integrity gate before the harvest and before the mutator cursor
+        # advances: a LanePoisoned raise here leaves the window fully
+        # replayable (consume_window not yet called)
+        runner.supervisor.raise_if_poisoned(runner, "megachunk")
         self._last_new_words = np.asarray(jax.device_get(out.new_words))
         b_done = int(jax.device_get(out.batches))
         incomplete = bool(jax.device_get(out.incomplete))

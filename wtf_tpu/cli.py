@@ -93,6 +93,16 @@ def _add_backend_tuning(p: argparse.ArgumentParser, mesh: bool = False
                         "chronically diverting lanes.  auto = platform "
                         "default (on off-CPU); on/off force it, e.g. to "
                         "run or bench the tier on the CPU platform")
+    p.add_argument("--supervise", action="store_true",
+                   help="self-healing device runtime (wtf_tpu/supervise): "
+                        "watchdogged dispatches, rebuild-and-replay "
+                        "recovery, the degradation ladder, per-batch "
+                        "integrity checks + lane quarantine")
+    p.add_argument("--dispatch-timeout", type=float, default=0.0,
+                   metavar="SECS",
+                   help="watchdog bound for ONE base-chunk dispatch "
+                        "(scaled by chunk steps and megachunk window); "
+                        "0 = no watchdog.  Implies --supervise")
 
 
 def _backend_tuning_kwargs(args) -> dict:
@@ -103,6 +113,10 @@ def _backend_tuning_kwargs(args) -> dict:
     mesh = getattr(args, "mesh_devices", None)
     if mesh is not None:
         kwargs["mesh_devices"] = mesh
+    timeout = getattr(args, "dispatch_timeout", 0.0) or 0.0
+    if getattr(args, "supervise", False) or timeout:
+        kwargs["supervise"] = True
+        kwargs["dispatch_timeout"] = timeout
     return kwargs
 
 

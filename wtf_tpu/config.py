@@ -155,6 +155,13 @@ class CampaignOptions:
     # whole batches — restore/mutate/insert/execute/reduce — per
     # compiled dispatch (0 = off; needs --mutator devmangle + --limit)
     megachunk: int = 0
+    # self-healing device runtime (wtf_tpu/supervise): watchdogged
+    # dispatches, rebuild-and-replay recovery, the degradation ladder,
+    # per-batch integrity checks + lane quarantine.  dispatch_timeout is
+    # the watchdog bound for ONE base-chunk dispatch (scaled by chunk
+    # steps / megachunk window); nonzero implies supervise
+    supervise: bool = False
+    dispatch_timeout: float = 0.0
     paths: TargetPaths = dataclasses.field(default_factory=TargetPaths)
 
 

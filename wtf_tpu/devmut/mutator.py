@@ -134,8 +134,12 @@ class DevMangleMutator(Mutator):
         routes through the runner (mesh runners shard the seed stream);
         tenant-scoped engines (wtf_tpu/tenancy) dispatch the plain
         engine over their lane quota, which is bit-exact by the same
-        per-lane program."""
-        return self.runner.devmut_generate(rounds, data, lens, cumw, seeds)
+        per-lane program.  Routed through the runner's supervisor with
+        wait=False: prelaunch is deliberately async (the double-buffer
+        overlap), so a hang here surfaces at the next fenced seam."""
+        return self.runner.supervisor.dispatch(
+            "devmut-generate", self.runner.devmut_generate,
+            rounds, data, lens, cumw, seeds, wait=False)
 
     def prelaunch(self) -> None:
         """Dispatch generation of the NEXT batch onto the device queue
@@ -212,6 +216,23 @@ class DevMangleMutator(Mutator):
         self.stats["batches"] += n
         self.stats["generated"] += n * self.n_lanes
 
+    def cancel_pending(self) -> None:
+        """Entering window mode with a prelaunched legacy batch in
+        flight (megachunk re-promotion after a degradation episode, or
+        the first window after a batch-at-a-time replay): discard the
+        prelaunched arrays and REWIND the cursor so the window
+        regenerates the same stream index in-graph — without the rewind,
+        consume_window would skip one batch of the deterministic stream.
+        The discarded dispatch's output is simply dropped unread; the
+        slab's as-uploaded view (synced by that prelaunch, before any
+        harvest adds) is exactly the view the window's first batch is
+        entitled to, so the in-graph regeneration is byte-identical."""
+        if self._pending is not None:
+            self._pending = None
+            self._batch -= 1
+            self.stats["batches"] -= 1
+            self.stats["generated"] -= self.n_lanes
+
     def set_current(self, words, lens) -> None:
         """Point the harvest seam (fetch / current_batch) at one window
         batch's device arrays — the megachunk outputs snapshots of the
@@ -270,23 +291,41 @@ class DevMangleMutator(Mutator):
             "slab": self.corpus.checkpoint_state(),
         }
 
-    def restore_state(self, state: dict) -> None:
+    def restore_state(self, state: dict,
+                      regenerate: Optional[bool] = None) -> None:
         """Install a checkpoint into a freshly-bound mutator (bind() and
         seed_from() already ran; their slab is discarded wholesale).
         Regenerates the in-flight prelaunched batch from the slab view
         the original run uploaded, then marks the slab stale so the next
         prelaunch re-uploads the current (post-harvest) view — exactly
-        the upload the uninterrupted run would have paid."""
+        the upload the uninterrupted run would have paid.
+
+        `regenerate` defaults to the checkpoint's own pending flag.  The
+        supervisor's recovery passes True: a megachunk-boundary snapshot
+        carries pending=False, but when the replay runs batch-at-a-time
+        (the ladder stepped below megachunk) the NEXT batch is still
+        entitled to the as-uploaded slab view — without regeneration,
+        take_batch's inline dispatch would re-upload the newer host slab
+        (mark_stale below) and break the one-batch lag."""
         if self.corpus is None:
             raise RuntimeError("devmangle restore before bind()")
         self.seed = int(state["seed"]) & ((1 << 64) - 1)
         self.corpus.restore(state["slab"])
         self._current = None
         self._pending = None
-        if state.get("pending"):
-            # _dispatch consumes the cached uploaded view and increments
-            # the cursor back to the checkpointed value
-            self._batch = int(state["batch"]) - 1
+        had_pending = bool(state.get("pending"))
+        if had_pending if regenerate is None else regenerate:
+            # _dispatch consumes the cached uploaded view; the cursor of
+            # a pending=True checkpoint already counted the prelaunched
+            # batch, a window-boundary one did not
+            self._batch = int(state["batch"]) - (1 if had_pending else 0)
+            if int(state["slab"]["uploaded"]["count"]) == 0:
+                # the snapshot predates the FIRST slab upload (batch 0):
+                # the undo log reconstructs an empty as-uploaded view, so
+                # there is nothing to honor — the entitled view is a
+                # fresh sync of the current slab, exactly the upload the
+                # inline take_batch dispatch would have paid
+                self.corpus.mark_stale()
             self._pending = self._dispatch()
         else:
             self._batch = int(state["batch"])

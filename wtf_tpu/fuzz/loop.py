@@ -224,6 +224,12 @@ class FuzzLoop:
         # re-places it onto that many devices and resumes bit-identically
         self.reshard_policy = None
         self.reshard_to: Optional[int] = None
+        # self-healing device runtime (wtf_tpu/supervise): when the
+        # backend's supervisor is armed, every batch runs under the
+        # snapshot -> dispatch -> (recover + replay)* -> post_batch
+        # wrapper in run_one_batch; the ladder attaches lazily at the
+        # first batch (the backend may not be initialized yet here)
+        self.supervisor = getattr(backend, "supervisor", None)
         if self.checkpoint_every and not hasattr(backend, "coverage_state"):
             # fail at construction, not at the first cadence hit deep
             # into a campaign (the checkpoint needs the batched backend's
@@ -280,12 +286,51 @@ class FuzzLoop:
             self.target.restore()
             self.backend.restore()
 
+    def _supervised(self):
+        """The armed supervisor, ladder attached — or None (the common,
+        unsupervised case)."""
+        sup = self.supervisor
+        if sup is None or not sup.enabled:
+            return None
+        if sup.ladder is None:
+            sup.attach_loop(self)
+        return sup
+
     def run_one_batch(self) -> int:
         """Returns the number of crashes found in this batch (for a
         megachunk window: in the whole window; the window's extra
-        completed batches advance `batches_done` internally)."""
+        completed batches advance `batches_done` internally).
+
+        Under supervision (wtf_tpu/supervise) the batch body runs inside
+        the recovery wrapper: a DispatchFailure (hang, device error,
+        poisoned lane) rebuilds the device plane from the batch-boundary
+        snapshot and REPLAYS the batch — bit-identical, because the
+        failed attempt consumed no host randomness and its decode work
+        is a prefix of the same deterministic stream."""
+        sup = self._supervised()
+        if sup is None:
+            return self._dispatch_batch()
+        from wtf_tpu.supervise import DispatchFailure
+
+        sup.pre_batch(self)
+        attempts = 0
+        while True:
+            try:
+                crashes = self._dispatch_batch()
+            except DispatchFailure as failure:
+                attempts += 1
+                if attempts > sup.max_batch_retries:
+                    raise
+                sup.recover(self, failure)
+                continue
+            sup.post_batch(self)
+            return crashes
+
+    def _dispatch_batch(self) -> int:
         if self.mutate_on_device:
-            if self.megachunk:
+            sup = self.supervisor
+            if self.megachunk and not (
+                    sup is not None and sup.megachunk_disabled):
                 return self._run_megachunk_window()
             return self._run_one_batch_device()
         spans = self.registry.spans
@@ -356,6 +401,12 @@ class FuzzLoop:
         with the checkpoint cadence and the runs budget — a `--resume`
         from any such boundary stays bit-identical (PR-8 contract)."""
         spans = self.registry.spans
+        # legacy->window handoff (megachunk re-promotion after a
+        # degradation episode): a prelaunched legacy batch in flight is
+        # discarded and the cursor rewound, so the window regenerates
+        # the same stream index in-graph (DevMangleMutator.cancel_pending
+        # — skipping it would skip one batch of the deterministic stream)
+        self.mutator.cancel_pending()
         window = self.megachunk
         if self.checkpoint_every:
             window = min(window, self.checkpoint_every
@@ -434,10 +485,13 @@ class FuzzLoop:
         """stats_every cadence: the stable human line + one JSONL
         heartbeat carrying the full registry dump (per-phase span totals
         included)."""
+        fields = (self.supervisor.heartbeat_fields()
+                  if self.supervisor is not None
+                  and self.supervisor.enabled else {})
         self.stats.maybe_heartbeat(
             self.events, self.registry,
             lambda: self.stats.line(len(self.corpus), self._coverage()),
-            every=self.stats_every, print_stats=print_stats)
+            every=self.stats_every, print_stats=print_stats, **fields)
 
     def minset(self, outputs_dir, print_stats: bool = False) -> Corpus:
         """`--runs=0` mode: replay the seed corpus exactly once — no

@@ -49,6 +49,7 @@ from wtf_tpu.interp.machine import (
 from wtf_tpu.interp.step import make_run_chunk
 from wtf_tpu.interp.uoptable import DecodeCache
 from wtf_tpu.snapshot.loader import Snapshot
+from wtf_tpu.supervise import Supervisor
 from wtf_tpu.telemetry import NULL, Registry, StatsDict
 
 MASK64 = (1 << 64) - 1
@@ -629,11 +630,19 @@ class Runner:
         fused_resume_steps: int = 1,
         burst_any_tier: Optional[bool] = None,
         tenants=None,
+        supervisor: Optional[Supervisor] = None,
     ):
         # Telemetry: metrics registry (private unless the backend/CLI hands
         # in a shared one) + JSONL event sink (NULL swallows when unwired)
         self.registry = registry if registry is not None else Registry()
         self.events = events if events is not None else NULL
+        # Every device dispatch seam routes through the supervisor
+        # (wtf_tpu/supervise): inert by default (one `is None` test per
+        # dispatch), armed by the backend for watchdog/recovery/chaos.
+        # A rebuilt Runner SHARES its backend's supervisor so dispatch
+        # indices and telemetry survive recovery.
+        self.supervisor = supervisor if supervisor is not None \
+            else Supervisor(registry=self.registry, events=self.events)
         self.snapshot = snapshot
         self.physmem = snapshot.physmem
         # extra executor-identity tag mixed into compile-event keys
@@ -761,6 +770,7 @@ class Runner:
             gauges=("max_chunk_steps",),
             labeled=("fallbacks_by_opclass",))
         self.stats["max_chunk_steps"] = chunk_steps
+        self.supervisor.attach_runner(self)
 
     # -- per-lane tenant routing (wtf_tpu/tenancy; single-image batches
     # are tenant 0 everywhere) ----------------------------------------------
@@ -906,9 +916,10 @@ class Runner:
                          dtype=np.uint32)
         extra = (jnp.asarray(np.asarray(active, dtype=bool)),) if masked \
             else ()
-        self.machine = fn(self.machine, words, lens,
-                          jnp.asarray(np.asarray(pfns, dtype=np.int32)),
-                          jnp.asarray(gva_l), *extra)
+        self.machine = self.supervisor.dispatch(
+            "device-insert", fn, self.machine, words, lens,
+            jnp.asarray(np.asarray(pfns, dtype=np.int32)),
+            jnp.asarray(gva_l), *extra, sync=lambda m: m.status)
 
     def push(self, view: HostView) -> None:
         """Apply a HostView's mutations (registers + buffered page writes +
@@ -1313,13 +1324,18 @@ class Runner:
                              donate=self._donate, kind="fused-resume")
         for _ in range(max(self.fused_rounds, 1)):
             with spans.span("pallas-step") as sp:
-                self.machine = run_fused(tab, self.image,
-                                         self.machine, limit)
+                self.machine = self.supervisor.dispatch(
+                    "fused", run_fused, tab, self.image,
+                    self.machine, limit,
+                    steps=self.fused_k, sync=lambda m: m.status)
                 sp.fence(self.machine.status)
             with spans.span("device-step") as sp:
                 # resumes parked lanes; ends with NO lane in NEEDS_XLA
-                self.machine = run_resume(tab, self.image,
-                                          self.machine, limit)
+                self.machine = self.supervisor.dispatch(
+                    "fused-resume", run_resume, tab, self.image,
+                    self.machine, limit,
+                    steps=self.fused_resume_steps,
+                    sync=lambda m: m.status)
                 sp.fence(self.machine.status)
             # copy, not a view (donation note in run())
             status = np.array(jax.device_get(self.machine.status))
@@ -1380,8 +1396,10 @@ class Runner:
                                          str(d) for d in
                                          self.image.frame_table.shape))
                 with spans.span("device-step") as sp:
-                    self.machine = run_chunk(
-                        tab, self.image, self.machine, limit)
+                    self.machine = self.supervisor.dispatch(
+                        "chunk", run_chunk,
+                        tab, self.image, self.machine, limit,
+                        steps=size, sync=lambda m: m.status)
                     # explicit fence: JAX dispatch is async; without it
                     # this span times Python dispatch and the device time
                     # leaks into whichever later span synchronizes first
@@ -1481,7 +1499,38 @@ class Runner:
             with spans.span("service-push"):
                 self.push(view)
                 tab = self.device_tab()
-        raise RuntimeError("run loop exceeded max_chunks")
+        # max_chunks exhausted: revoke the lanes still making (or
+        # awaiting) progress as TIMEDOUT — burst semantics, their chunk
+        # budget ran out — instead of aborting the whole batch.  One
+        # runaway lane must not kill a campaign; TIMEDOUT lanes are
+        # already excluded from the coverage merge by the backend's
+        # include mask, so no partial-execution edges are credited.
+        status = np.array(jax.device_get(self.machine.status))
+        fault_statuses = (int(StatusCode.PAGE_FAULT),
+                          int(StatusCode.DIVIDE_ERROR))
+        nonterminal = [int(StatusCode.RUNNING), int(StatusCode.NEED_DECODE),
+                       int(StatusCode.SMC), int(StatusCode.UNSUPPORTED),
+                       int(StatusCode.BREAKPOINT), int(StatusCode.NEEDS_XLA)]
+        if self.deliver_exceptions:
+            # deliverable faults would have gone back to the guest too
+            nonterminal += list(fault_statuses)
+        stuck = [int(lane)
+                 for lane in np.nonzero(np.isin(status, nonterminal))[0]
+                 if int(lane) not in undeliverable
+                 and not (int(status[lane]) in fault_statuses
+                          and not self._deliver_lane(int(lane)))]
+        if stuck:
+            view = self.view()
+            for lane in stuck:
+                self.lane_errors.setdefault(
+                    lane, f"revoked: exceeded max_chunks={max_chunks}")
+                view.set_status(lane, StatusCode.TIMEDOUT)
+            self.push(view)
+            self.registry.counter("runner.max_chunks_timeouts").inc(
+                len(stuck))
+            self.events.emit("timeout", kind="max-chunks", lanes=stuck,
+                             chunks=max_chunks)
+        return np.array(jax.device_get(self.machine.status))
 
     def restore(self) -> None:
         """Every lane back to the snapshot: O(1) overlay reset + register

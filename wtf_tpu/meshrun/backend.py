@@ -46,7 +46,9 @@ class MeshBackend(TpuBackend):
         self.mesh = make_mesh(self._mesh_devices)
         self.runner = MeshRunner(self.snapshot, self.n_lanes,
                                  mesh=self.mesh, registry=self.registry,
-                                 events=self.events, **self._runner_kwargs)
+                                 events=self.events,
+                                 supervisor=self.supervisor,
+                                 **self._runner_kwargs)
         m = self.runner.machine
         rep = replicated_sharding(self.mesh)
         # aggregates live replicated on every chip, so the merge's only

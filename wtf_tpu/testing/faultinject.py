@@ -14,6 +14,13 @@ code that actually ships — not mocks of it — by wrapping two seams:
                  FuzzLoop.fuzz loop and "kills" it at a chosen batch
                  boundary; `tear_file(path)` simulates the torn file a
                  pre-atomic kill would have left
+  device plane   `chaos_device(plan)` arms wtf_tpu/supervise's
+                 `_DEVICE_FAULT` hook: scripted hangs, device errors and
+                 lane poisoning fire on exact GLOBAL DISPATCH INDICES
+                 (every supervised seam counts one), so watchdog /
+                 rebuild / quarantine recovery is provable in CI with no
+                 wall-clock — an injected hang raises DispatchHang
+                 immediately rather than sleeping out a real timeout
 
 Determinism contract: a schedule is either scripted explicitly or drawn
 once from `random.Random(seed)` at plan construction.  Faults fire on
@@ -32,6 +39,10 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from wtf_tpu.dist import wire
+from wtf_tpu.supervise import (
+    DEVICE_ERROR, DEVICE_HANG, DEVICE_POISON, MACHINE_SEAMS,
+)
+from wtf_tpu.supervise import supervisor as _supervisor
 from wtf_tpu.utils import atomicio
 
 RESET = "reset"
@@ -40,6 +51,7 @@ PARTIAL_RECV = "partial-recv"
 DELAY = "delay"
 
 _KINDS = (RESET, PARTIAL_SEND, PARTIAL_RECV, DELAY)
+_DEVICE_KINDS = (DEVICE_HANG, DEVICE_ERROR, DEVICE_POISON)
 
 
 class SimulatedKill(Exception):
@@ -59,11 +71,15 @@ class FaultPlan:
 
     def __init__(self, socket_schedules: Optional[List[Dict[int, str]]]
                  = None, write_faults=(), delay_secs: float = 0.005,
-                 write_error: Optional[OSError] = None):
+                 write_error: Optional[OSError] = None,
+                 device_faults: Optional[Dict[int, object]] = None):
         self.socket_schedules = [dict(s) for s in (socket_schedules or [])]
         self.write_faults = set(write_faults)
         self.delay_secs = delay_secs
         self.write_error = write_error
+        # {global supervised-dispatch index: kind | (kind, arg)} — arg is
+        # the lane for DEVICE_POISON
+        self.device_faults = dict(device_faults or {})
         self._next_socket = 0
         self._next_write = 0
         # observability for assertions: what actually fired
@@ -98,6 +114,23 @@ class FaultPlan:
 
     def count_fired(self, kind: str) -> int:
         return sum(1 for f in self.fired if f[0] == kind)
+
+    # -- the supervise hook ------------------------------------------------
+    def _device_hook(self, seam: str, index: int):
+        """Supervisor.dispatch consults this with the seam name and the
+        global dispatch index.  Poison scheduled on a seam whose output
+        carries no machine state (devmut-generate) slides to the next
+        index instead of silently vanishing — the plan stays meaningful
+        whatever dispatch interleaving the ladder rung produces."""
+        fault = self.device_faults.pop(index, None)
+        if fault is None:
+            return None
+        kind, arg = fault if isinstance(fault, tuple) else (fault, None)
+        if kind == DEVICE_POISON and seam not in MACHINE_SEAMS:
+            self.device_faults[index + 1] = (kind, arg)
+            return None
+        self.note(kind, seam, index)
+        return (kind, arg)
 
     # -- the atomicio hook -------------------------------------------------
     def _write_hook(self, path) -> None:
@@ -202,6 +235,21 @@ def chaos_checkpoint_io(plan: FaultPlan):
         yield plan
     finally:
         atomicio._WRITE_FAULT = previous
+
+
+@contextmanager
+def chaos_device(plan: FaultPlan):
+    """Within the context, every supervised device dispatch consults the
+    plan's device schedule (supervise/supervisor.py's `_DEVICE_FAULT`
+    global — the same arming pattern as atomicio's `_WRITE_FAULT`).
+    Supervisors stay on their fast path when the plan has no device
+    faults left, so an exhausted plan costs one dict lookup."""
+    previous = _supervisor._DEVICE_FAULT
+    _supervisor._DEVICE_FAULT = plan._device_hook
+    try:
+        yield plan
+    finally:
+        _supervisor._DEVICE_FAULT = previous
 
 
 def fuzz_until_killed(loop, runs: int, kill_at_batch: int) -> None:
