@@ -8,10 +8,10 @@ PY ?= python
 
 .PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke \
 	triage-smoke tenancy-smoke fleet-smoke fused-smoke \
-	device-chaos-smoke
+	device-chaos-smoke decode-smoke
 
 verify: test lint chaos-smoke triage-smoke tenancy-smoke fleet-smoke \
-	fused-smoke device-chaos-smoke
+	fused-smoke device-chaos-smoke decode-smoke
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -86,3 +86,11 @@ chaos-smoke:
 # fault-free run (coverage, edge bytes, corpus digests, crash buckets)
 device-chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.device_chaos_smoke
+
+# zero-host steady-state smoke (wtf_tpu/testing/decode_smoke): a
+# cold-cache --device-decode demo_tlv campaign must finish its
+# megachunk windows with ZERO host decode services, a clean
+# device-vs-host cross-check, >=1 adopted pipelined-harvest prelaunch,
+# and stay bit-identical to the host-serviced reference
+decode-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.decode_smoke

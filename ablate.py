@@ -222,6 +222,72 @@ def measure_megachunk(n_lanes=None, limit=100_000, seconds=10.0,
     return cols
 
 
+def measure_decode(n_lanes=None, limit=100_000, seconds=10.0, window=16):
+    """Device-decode A/B (the zero-host-steady-state tentpole): the
+    same devmangle megachunk campaign host-serviced vs with
+    `--device-decode` (wtf_tpu/interp/devdec), both from a COLD decode
+    cache — the cold-start service storm is exactly the host cost the
+    in-graph decoder removes.  Reports execs/s, the fenced host/device
+    wall split, the host decode-service count (the A column's cost, the
+    B column's zero), zero-host window share, and the pipelined-harvest
+    overlap share (prelaunch adoptions / windows)."""
+    import jax
+
+    from wtf_tpu.analysis.trace import build_tlv_campaign
+    from wtf_tpu.telemetry.spans import DEVICE_SPAN_LEAVES
+
+    if n_lanes is None:
+        n_lanes = 1024 if jax.default_backend() == "tpu" else 64
+    cols = {}
+    for mode, dd in (("host", False), ("device", True)):
+        loop = build_tlv_campaign(n_lanes=n_lanes, mutator="devmangle",
+                                  limit=limit, chunk_steps=512,
+                                  overlay_slots=32, megachunk=window,
+                                  device_decode=dd)
+        def dev_seconds():
+            # re-resolve: the per-leaf children only materialize once
+            # their spans first fire (this A/B starts cold on purpose)
+            children = loop.registry.counter("phase.seconds").children
+            return sum(c.value for path, c in children.items()
+                       if path.split("/")[-1] in DEVICE_SPAN_LEAVES)
+
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            loop.run_one_batch()
+        dt = time.time() - t0
+        dev_s = dev_seconds()
+        reg = loop.registry
+        windows = reg.counter("megachunk.windows").value
+        col = {
+            "execs_per_s": round(loop.stats.testcases / dt, 2),
+            "host_decode_services": loop.backend.runner.stats["decodes"],
+            "device_s": round(dev_s, 4),
+            "host_s": round(max(dt - dev_s, 0.0), 4),
+            "host_share_of_wall": round(max(dt - dev_s, 0.0) / dt, 4),
+            "windows": int(windows),
+        }
+        if dd:
+            col["device_published"] = int(
+                reg.counter("devdec.published").value)
+            col["crosscheck_mismatches"] = int(
+                reg.counter("devdec.crosscheck_mismatches").value)
+            col["zero_host_windows"] = int(
+                reg.counter("devdec.zero_host_windows").value)
+            col["zero_host_batches"] = int(
+                reg.counter("devdec.zero_host_batches").value)
+            col["prelaunch_hits"] = int(
+                reg.counter("megachunk.prelaunch_hits").value)
+            col["harvest_overlap_share"] = round(
+                col["prelaunch_hits"] / max(windows, 1), 4)
+        cols[mode] = col
+    print(json.dumps({
+        "config": "decode", "n_lanes": n_lanes, "limit": limit,
+        "window": window, "platform": jax.devices()[0].platform,
+        "host_serviced": cols["host"], "device_decode": cols["device"],
+    }), flush=True)
+    return cols
+
+
 def measure_lanes_ramp(seconds=None, limit=20_000):
     """The chips x lanes ramp (ROADMAP item 1 / ISSUE 7): devmangle
     campaigns through the meshrun driver at lanes x mesh-shard
@@ -440,8 +506,8 @@ if __name__ == "__main__":
     faulthandler.dump_traceback_later(
         int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
     names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused", "devmut",
-                                             "megachunk", "lanes",
-                                             "tenants", "fleet"]
+                                             "megachunk", "decode",
+                                             "lanes", "tenants", "fleet"]
     for n in names:
         if n == "deep":
             measure_deep()
@@ -451,6 +517,8 @@ if __name__ == "__main__":
             measure_devmut()
         elif n == "megachunk":
             measure_megachunk()
+        elif n == "decode":
+            measure_decode()
         elif n == "lanes":
             measure_lanes_ramp()
         elif n == "tenants":

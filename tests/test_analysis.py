@@ -129,15 +129,18 @@ def test_budget_rule_fires_on_improvement_too():
 
 def test_checked_in_budget_matches_perf_record():
     """analysis/budgets.json pins the step ladder at the PERF.md
-    round-16 math: 166 surviving data-dependent kernels (79/59/28 —
-    round 8's 168 minus the CPUID row gather and one x87 stack
-    gather)."""
+    round-18 math: 165 surviving data-dependent kernels (78/59/28 —
+    round 16's 166 minus the uop-fetch rip_l gather that the packed
+    one-gather lookup made dead)."""
     budget = load_budgets()["xla_step"]
-    assert budget["total"] == 166
+    assert budget["total"] == 165
     assert (budget["gather"], budget["dynamic-slice"],
-            budget["dynamic-update-slice"]) == (79, 59, 28)
+            budget["dynamic-update-slice"]) == (78, 59, 28)
     # the tenant ladder is the SAME program over a stacked image table
-    assert load_budgets()["tenant_chunk"]["total"] == 166
+    assert load_budgets()["tenant_chunk"]["total"] == 165
+    # the in-graph decode service compiles as its own pinned graph
+    # (round 18) so decoder growth is a lint finding, not silent fusion
+    assert load_budgets()["decode_service"]["total"] == 268
 
 
 def test_rebaseline_is_a_ratchet():

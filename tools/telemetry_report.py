@@ -313,6 +313,52 @@ def summarize(path) -> dict:
             round(fleet["bucket_dedup_hits"] / crashes_seen, 4)
             if crashes_seen else None)
 
+    # device-resident decode (interp/devdec): the zero-host steady
+    # state.  published = entries the in-graph decoder committed,
+    # cross-checked entry-by-entry against the host oracle at harvest
+    # (mismatches MUST read 0 — any other value is a decoder bug, not
+    # noise).  zero-host windows are megachunk windows that completed
+    # without a single host decode service; their mean length in batches
+    # says how long the device runs untouched.  harvest_overlap_share is
+    # the fraction of windows whose successor was speculatively
+    # prelaunched AND adopted, i.e. readback hidden behind execution.
+    # None when the run never exercised device decode.
+    devdecode = None
+    dd_signals = {
+        "published": metrics.get("devdec.published", 0) or 0,
+        "serviced_lanes": metrics.get("devdec.serviced_lanes", 0) or 0,
+        "parked_lanes": metrics.get("devdec.parked_lanes", 0) or 0,
+        "service_rounds": metrics.get("devdec.service_rounds", 0) or 0,
+        "zero_host_windows": metrics.get("devdec.zero_host_windows",
+                                         0) or 0,
+        "zero_host_batches": metrics.get("devdec.zero_host_batches",
+                                         0) or 0,
+    }
+    if any(dd_signals.values()):
+        devdecode = dict(dd_signals)
+        devdecode["crosscheck_mismatches"] = metrics.get(
+            "devdec.crosscheck_mismatches", 0) or 0
+        # host decode services that still happened (parked encodings
+        # serviced in-order by the authoritative host decoder); 0 is the
+        # acceptance target for the demo workloads
+        devdecode["host_decode_services"] = metrics.get(
+            "runner.decodes", 0) or 0
+        devdecode["zero_host_mean_batches"] = (
+            round(devdecode["zero_host_batches"]
+                  / devdecode["zero_host_windows"], 1)
+            if devdecode["zero_host_windows"] else None)
+        mega_windows = metrics.get("megachunk.windows", 0) or 0
+        devdecode["windows"] = mega_windows
+        devdecode["prelaunched"] = metrics.get("megachunk.prelaunched",
+                                               0) or 0
+        devdecode["prelaunch_hits"] = metrics.get(
+            "megachunk.prelaunch_hits", 0) or 0
+        devdecode["prelaunch_dropped"] = metrics.get(
+            "megachunk.prelaunch_dropped", 0) or 0
+        devdecode["harvest_overlap_share"] = (
+            round(devdecode["prelaunch_hits"] / mega_windows, 4)
+            if mega_windows else None)
+
     testcases = metrics.get("campaign.testcases", 0) or 0
     fallbacks = metrics.get("runner.fallbacks_by_opclass", {})
     if not isinstance(fallbacks, dict):
@@ -381,6 +427,7 @@ def summarize(path) -> dict:
         "resilience": resilience,
         "device_resilience": device_res,
         "fleet": fleet,
+        "device_decode": devdecode,
         "errors": errors,
     }
 
@@ -535,6 +582,26 @@ def _print_human(s: dict) -> None:
               f"dedup={flt['store_dedup_hits']} "
               f"bucket-dedup={flt['bucket_dedup_hits']} "
               f"reshards={flt['reshards']}")
+    ddc = s.get("device_decode")
+    if ddc:
+        check = ("clean" if ddc["crosscheck_mismatches"] == 0
+                 else f"{ddc['crosscheck_mismatches']} MISMATCHES")
+        mean = (f", mean {ddc['zero_host_mean_batches']} batches"
+                if ddc.get("zero_host_mean_batches") is not None else "")
+        overlap = (f"{ddc['harvest_overlap_share'] * 100:.1f}%"
+                   if ddc.get("harvest_overlap_share") is not None
+                   else "n/a")
+        print(f"device decode: published={ddc['published']} "
+              f"(cross-check {check}) "
+              f"serviced={ddc['serviced_lanes']} "
+              f"parked={ddc['parked_lanes']} "
+              f"rounds={ddc['service_rounds']} "
+              f"host-services={ddc['host_decode_services']}")
+        print(f"  zero-host windows: {ddc['zero_host_windows']}"
+              f"/{ddc['windows']}{mean}; harvest overlap {overlap} "
+              f"(prelaunched {ddc['prelaunched']}, "
+              f"adopted {ddc['prelaunch_hits']}, "
+              f"dropped {ddc['prelaunch_dropped']})")
     for err in s["errors"]:
         print(f"error: {err['kind']}: {err['detail']}")
 

@@ -99,6 +99,15 @@ MESH_DEVICES = 8
 MESH_CONFIG = dict(n_steps=16, lanes_per_shard=2,
                    uop_capacity=1 << 10, overlay_slots=8, edge_bits=12)
 
+# canonical device-decode service configuration (wtf_tpu/interp/devdec):
+# ONE in-graph service round — the vmapped per-lane decode blocks plus
+# the sequential publish-order commit — lowered at the budget runner's
+# shapes and pinned as its own entry.  The decode graph rides inside
+# the megachunk window, so this census is the marginal gather-class
+# kernel cost a `--device-decode` campaign pays per service round.
+DECODE_ENTRY = "decode_service"
+DECODE_BP_SLOTS = 8
+
 FAMILIES = ("dtype", "budget", "recompile", "parity", "mesh", "supervise")
 
 _FORBID_64 = re.compile(r"\b(u64|s64|f64|f32)\[")
@@ -181,7 +190,7 @@ def _dtype_arg_recipes() -> Dict[str, Tuple]:
         rip_l=jnp.zeros((cap, 2), jnp.uint32),
         meta_i32=jnp.zeros((cap, 4), jnp.int32),
         meta_u64=jnp.zeros((cap, 4), jnp.uint32),
-        hash_tab=jnp.full((cap * 4,), -1, jnp.int32),
+        hash_tab=jnp.full((cap * 4, 3), -1, jnp.int32),
     )
     gl = jnp.zeros((16, 2), jnp.uint32)
     recipes: Dict[str, Tuple] = {
@@ -354,6 +363,45 @@ def check_budget(counts: Dict[str, int], budget: Dict[str, int],
     return findings
 
 
+def decode_service_lowering(runner):
+    """Lower one devdec service round (compute_blocks + commit_blocks)
+    at the budget runner's shapes — the `decode_service` census
+    subject."""
+    import jax
+    import jax.numpy as jnp
+
+    from wtf_tpu.interp import devdec
+    from wtf_tpu.mem.physmem import lane_image
+
+    capacity = runner.cache.capacity
+
+    def service(tab, image, machine, count, bp_keys, n_bp):
+        blocks = devdec.compute_blocks(tab, image, machine, bp_keys, n_bp)
+        return devdec.commit_blocks(tab, count, blocks, machine.status,
+                                    capacity)
+
+    return jax.jit(service).lower(
+        runner.cache.device(),
+        lane_image(runner.physmem.image, runner.n_lanes),
+        runner.machine, jnp.int32(0),
+        jnp.zeros(DECODE_BP_SLOTS, jnp.uint64), jnp.int32(0))
+
+
+def run_decode_rules(runner, budgets_path: Optional[Path] = None,
+                     rebaseline: bool = False):
+    """The `decode_service` kernel-count pin (budget family)."""
+    text = decode_service_lowering(runner).compile().as_text()
+    counts = count_data_dependent_ops(text)
+    info = {"decode_counts": counts,
+            "entry": f"decode_service(1 round) / demo_tlv / "
+                     f"n_lanes={runner.n_lanes}"}
+    findings: List[Finding] = []
+    if not rebaseline:
+        budget = load_budgets(budgets_path).get(DECODE_ENTRY, {})
+        findings = check_budget(counts, budget, entry=info["entry"])
+    return findings, info
+
+
 def check_triage_chunk() -> List[Finding]:
     """The triage replay core must dispatch the SAME compiled step
     ladder the campaign runs — zero new gather/DS/DUS kernels beyond the
@@ -448,7 +496,7 @@ def check_seam_enumeration() -> List[Finding]:
 
     claimed = set(SEAM_SITES)
     required = {"chunk", "fused", "fused-resume", "device-insert",
-                "devmut-generate", "megachunk"}
+                "devmut-generate", "megachunk", "device-decode"}
     missing = sorted(required - claimed)
     return [Finding(
         rule="supervise.seam-enumeration", entry="supervise.SEAM_SITES",
@@ -627,12 +675,19 @@ def check_runner_donation_policy(runner, entry: str = "interp.runner"
 
 
 def check_donation_aliasing(compiled_text: str, machine,
-                            n_prefix_params: int,
-                            entry: str) -> List[Finding]:
+                            n_prefix_params: int, entry: str,
+                            dropped_args=frozenset()) -> List[Finding]:
     """Every leaf of the donated machine argument must appear in the
     compiled module's input_output_alias map; an unaliased donated
     buffer is invalidated without the in-place win, and any host view of
-    it reads garbage."""
+    it reads garbage.
+
+    `dropped_args`: original flat-argument indices jit's dead-code
+    elimination pruned from the compiled module (kept_var_idx's
+    complement) — compiled param numbering skips them, so every dropped
+    index below a machine leaf shifts its param down by one.  A donated
+    machine leaf that is ITSELF dropped is still a finding (the buffer
+    is invalidated with no reuse)."""
     import jax
 
     header = compiled_text[:compiled_text.index("\n")]
@@ -643,7 +698,11 @@ def check_donation_aliasing(compiled_text: str, machine,
     findings = []
     for i, (path, _leaf) in enumerate(flat):
         param = n_prefix_params + i
-        if param not in aliased:
+        if param in dropped_args:
+            shifted = -1  # pruned outright: never aliased
+        else:
+            shifted = param - sum(1 for d in dropped_args if d < param)
+        if shifted not in aliased:
             findings.append(Finding(
                 rule="recompile.donation-unaliased", entry=entry,
                 primitive=f"machine{jax.tree_util.keystr(path)} "
@@ -904,10 +963,12 @@ def run_lint(families: Optional[Sequence[str]] = None,
         findings.extend(run_dtype_family())
         info["seconds"]["dtype"] = round(time.time() - t0, 1)
 
+    compiled = None
     compiled_text = None
     if "budget" in families:
         t0 = time.time()
-        compiled_text = lowered.compile().as_text()
+        compiled = lowered.compile()
+        compiled_text = compiled.as_text()
         counts = count_data_dependent_ops(compiled_text)
         info["kernel_counts"] = counts
         if rebaseline:
@@ -935,6 +996,20 @@ def run_lint(families: Optional[Sequence[str]] = None,
                 "entry": tenant_info["entry"], **counts_t}
         for name, value in counts_t.items():
             registry.gauge("analysis.tenant_kernel_count").labels(
+                name).set(value)
+        # device-decode service graph (interp/devdec): its own pin —
+        # the marginal kernel cost of a `--device-decode` service round
+        decode_findings, decode_info = run_decode_rules(
+            runner, budgets_path=budgets_path, rebaseline=rebaseline)
+        findings.extend(decode_findings)
+        counts_d = decode_info["decode_counts"]
+        info["decode_kernel_counts"] = counts_d
+        info["entries"].append(decode_info["entry"])
+        if rebaseline:
+            measured_budgets[DECODE_ENTRY] = {
+                "entry": decode_info["entry"], **counts_d}
+        for name, value in counts_d.items():
+            registry.gauge("analysis.decode_kernel_count").labels(
                 name).set(value)
         info["seconds"]["budget"] = round(time.time() - t0, 1)
 
@@ -967,13 +1042,24 @@ def run_lint(families: Optional[Sequence[str]] = None,
         # donation: policy gate + alias coverage of the donated executor
         findings.extend(check_runner_donation_policy(runner))
         if compiled_text is None:
-            compiled_text = lowered.compile().as_text()
+            compiled = lowered.compile()
+            compiled_text = compiled.as_text()
         import jax
 
         n_prefix = len(jax.tree_util.tree_leaves(runner.cache.device())) \
             + len(jax.tree_util.tree_leaves(runner.physmem.image))
+        n_machine = len(jax.tree_util.tree_leaves(runner.machine))
+        # jit DCEs unused flat args (e.g. tab.rip_l once uop_lookup's
+        # probe verifies against the hash rows' own key limbs), shifting
+        # the compiled param numbering the alias map indexes
+        kept = getattr(getattr(compiled, "_executable", None),
+                       "_kept_var_idx", None)
+        dropped = (frozenset(i for i in range(n_prefix + n_machine)
+                             if i not in kept)
+                   if kept is not None else frozenset())
         findings.extend(check_donation_aliasing(
-            compiled_text, runner.machine, n_prefix, entry=entry))
+            compiled_text, runner.machine, n_prefix, entry=entry,
+            dropped_args=dropped))
         info["seconds"]["recompile"] = round(time.time() - t0, 1)
 
     if "parity" in families:

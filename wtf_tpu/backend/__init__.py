@@ -31,7 +31,8 @@ def create_backend(name: str, snapshot, **kwargs) -> Backend:
         # supervision guards DEVICE dispatch seams; the pure-host oracle
         # backend has none
         for key in ("supervise", "dispatch_timeout", "promote_after",
-                    "max_batch_retries", "quarantine_threshold"):
+                    "max_batch_retries", "quarantine_threshold",
+                    "device_decode"):
             kwargs.pop(key, None)
         return EmuBackend(snapshot, **kwargs)
     if name == "tpu":

@@ -93,6 +93,14 @@ def _add_backend_tuning(p: argparse.ArgumentParser, mesh: bool = False
                         "chronically diverting lanes.  auto = platform "
                         "default (on off-CPU); on/off force it, e.g. to "
                         "run or bench the tier on the CPU platform")
+    p.add_argument("--device-decode", action="store_true",
+                   help="device-resident x86 decode (interp/devdec.py): "
+                        "megachunk windows service decode-cache misses "
+                        "in-graph (page-walked fetch + batched decode + "
+                        "publish-order slot reservation), parking only "
+                        "unsupported encodings for the host; the host "
+                        "decoder cross-checks every device-published "
+                        "entry at harvest")
     p.add_argument("--supervise", action="store_true",
                    help="self-healing device runtime (wtf_tpu/supervise): "
                         "watchdogged dispatches, rebuild-and-replay "
@@ -117,6 +125,8 @@ def _backend_tuning_kwargs(args) -> dict:
     if getattr(args, "supervise", False) or timeout:
         kwargs["supervise"] = True
         kwargs["dispatch_timeout"] = timeout
+    if getattr(args, "device_decode", False):
+        kwargs["device_decode"] = True
     return kwargs
 
 

@@ -118,6 +118,13 @@ class MegaOut(NamedTuple):
     new_words: jax.Array     # uint32[cov_w] last completed batch's delta
     prev: MegaSnap
     cur: MegaSnap
+    # --device-decode outputs (== inputs when the window was built
+    # without devdec): the post-window table with device-published rows,
+    # its live entry count (-1 when devdec off), and i32[4] stats
+    # (serviced lanes, published entries, parked lanes, service rounds)
+    tab: UopTable
+    count: jax.Array
+    dd_stats: jax.Array
 
 
 def _snap(words, lens) -> MegaSnap:
@@ -125,16 +132,36 @@ def _snap(words, lens) -> MegaSnap:
 
 
 def _make_body(max_batches: int, n_pages: int, len_gpr: int, ptr_gpr: int,
-               rounds: int, deliver: bool, merge_fn, any_fn, sum_fn):
+               rounds: int, deliver: bool, merge_fn, any_fn, sum_fn,
+               devdec_on: bool = False, gather_fn=None,
+               lane_base_fn=None):
     """The window body shared by the single-device and mesh programs.
     `merge_fn` is the batch coverage merge, `any_fn` a (possibly
     cross-shard) boolean any, `sum_fn` a (possibly psum'd) per-batch
-    counter total."""
+    counter total.
+
+    With `devdec_on` the window grows three operands — the live decode
+    cache count, the padded pending-breakpoint key vector, and its live
+    length — and decode misses are serviced IN-GRAPH (interp/devdec):
+    each quiesce that leaves NEED_DECODE lanes runs a service round
+    (per-lane block decode + walk, then a sequential global commit that
+    replays the host service's publish order exactly), re-quiescing
+    until every miss is serviced or parked.  Parked lanes stay
+    NEED_DECODE, so the ordinary early-return -> host service path picks
+    them up — bit-identical tables either way.  On a mesh, `gather_fn`
+    all-gathers the per-shard blocks so every shard runs the SAME
+    replicated commit (slot reservation is shard-correct by
+    construction: one deterministic global order, no per-shard
+    partitioning to reconcile), and `lane_base_fn` locates the shard's
+    lane span in the committed global arrays."""
     from wtf_tpu.devmut.engine import generate
+    from wtf_tpu.interp import devdec as DD
+    from wtf_tpu.interp.machine import CTR_MEM_FAULT
 
     insert = device_insert_impl(n_pages, len_gpr, ptr_gpr)
     step_v = jax.vmap(step_lane, in_axes=(None, IMAGE_IN_AXES, 0, None))
     serviceable = SERVICEABLE_DELIVER if deliver else SERVICEABLE_BASE
+    _ND = int(StatusCode.NEED_DECODE)
     B = max_batches
 
     def run_quiesce(tab, image, m, limit):
@@ -151,10 +178,10 @@ def _make_body(max_batches: int, n_pages: int, len_gpr: int, ptr_gpr: int,
 
         return lax.while_loop(cond, body, m)
 
-    def window(tab: UopTable, image: MemImage, machine: Machine,
-               template: Machine, slab_first: Tuple, slab_rest: Tuple,
-               seeds, pfns, gva_l, finish_l, limit, n_batches,
-               agg_cov, agg_edge) -> MegaOut:
+    def _window(tab: UopTable, image: MemImage, machine: Machine,
+                template: Machine, slab_first: Tuple, slab_rest: Tuple,
+                seeds, pfns, gva_l, finish_l, limit, n_batches,
+                agg_cov, agg_edge, dd) -> MegaOut:
         n_lanes = machine.status.shape[0]
         image = lane_image(image, n_lanes)
         n_words = slab_first[0].shape[1]
@@ -165,6 +192,67 @@ def _make_body(max_batches: int, n_pages: int, len_gpr: int, ptr_gpr: int,
             words=jnp.zeros((n_lanes, n_words), jnp.uint32),
             lens=jnp.zeros((n_lanes,), jnp.int32))
         nw0 = jnp.zeros_like(agg_cov)
+        if devdec_on:
+            count0, bp_keys, n_bp = dd
+            capacity = tab.rip_l.shape[0]
+            lane_base = (lane_base_fn(n_lanes) if lane_base_fn is not None
+                         else jnp.int32(0))
+
+            def gather(tree):
+                if gather_fn is None:
+                    return tree
+                return jax.tree.map(gather_fn, tree)
+
+            def lane_slice(a):
+                if gather_fn is None:
+                    return a
+                return lax.dynamic_slice_in_dim(a, lane_base, n_lanes, 0)
+
+            def service(tabst, cnt, m, dstats):
+                """In-graph decode-miss service rounds around the
+                quiesce: compute per-lane blocks against the round-start
+                table, commit them in global lane order (replicated on a
+                mesh), apply this shard's lane deltas, re-quiesce.
+                Stops when no un-parked lane is NEED_DECODE."""
+
+                def scond(c):
+                    _tabst, _cnt, m, _dstats, parked = c
+                    return any_fn((m.status == jnp.int32(_ND)) & ~parked)
+
+                def sbody(c):
+                    tabst, cnt, m, dstats, parked = c
+                    tl = tab._replace(
+                        hash_tab=tabst[0], rip_l=tabst[1],
+                        meta_i32=tabst[2], meta_u64=tabst[3])
+                    blocks = jax.vmap(
+                        DD.lane_block,
+                        in_axes=(None, IMAGE_IN_AXES, 0, 0, 0, 0, None,
+                                 None),
+                    )(tl, image, m.overlay, m.cr3, m.rip, m.status,
+                      bp_keys, n_bp)
+                    out = DD.commit_blocks(tl, cnt, gather(blocks),
+                                           gather(m.status), capacity)
+                    fm = lane_slice(out.fault_mask)
+                    m2 = m._replace(
+                        status=lane_slice(out.status),
+                        fault_gva=jnp.where(
+                            fm, lane_slice(out.fault_gva), m.fault_gva),
+                        fault_write=jnp.where(
+                            fm, jnp.int32(0), m.fault_write),
+                        ctr=m.ctr.at[:, CTR_MEM_FAULT].add(
+                            lane_slice(out.mem_fault_inc)))
+                    dstats2 = dstats + jnp.concatenate(
+                        [out.stats, jnp.ones((1,), jnp.int32)])
+                    m3 = run_quiesce(out.tab, image, m2, limit)
+                    return ((out.tab.hash_tab, out.tab.rip_l,
+                             out.tab.meta_i32, out.tab.meta_u64),
+                            out.count, m3, dstats2,
+                            parked | lane_slice(out.parked))
+
+                parked0 = jnp.zeros((n_lanes,), bool)
+                tabst, cnt, m, dstats, _parked = lax.while_loop(
+                    scond, sbody, (tabst, cnt, m, dstats, parked0))
+                return tabst, cnt, m, dstats
 
         def cond(carry):
             b, stop = carry[0], carry[1]
@@ -172,7 +260,10 @@ def _make_body(max_batches: int, n_pages: int, len_gpr: int, ptr_gpr: int,
 
         def body(carry):
             (b, _stop, incomplete, find_b, m, agg_c, agg_e, sts, flags,
-             ctrs, nw, prev, cur) = carry
+             ctrs, nw, prev, cur, tabst, cnt, dstats) = carry
+            tab_b = (tab._replace(hash_tab=tabst[0], rip_l=tabst[1],
+                                  meta_i32=tabst[2], meta_u64=tabst[3])
+                     if devdec_on else tab)
             first = b == 0
             data = jnp.where(first, slab_first[0], slab_rest[0])
             lens_s = jnp.where(first, slab_first[1], slab_rest[1])
@@ -181,7 +272,12 @@ def _make_body(max_batches: int, n_pages: int, len_gpr: int, ptr_gpr: int,
             words, lens = generate(data, lens_s, cumw, seeds[b],
                                    rounds=rounds)
             m = insert(m, words, lens, pfns, gva_l)
-            m = run_quiesce(tab, image, m, limit)
+            m = run_quiesce(tab_b, image, m, limit)
+            if devdec_on:
+                tabst, cnt, m, dstats = service(tabst, cnt, m, dstats)
+                tab_b = tab._replace(
+                    hash_tab=tabst[0], rip_l=tabst[1], meta_i32=tabst[2],
+                    meta_u64=tabst[3])
             # declarative stop: BREAKPOINT at the finish rip == the
             # host handler's stop(Ok()) — pre-execution, so no icount /
             # coverage for the breakpointed instruction, like the host
@@ -223,33 +319,65 @@ def _make_body(max_batches: int, n_pages: int, len_gpr: int, ptr_gpr: int,
             stop2 = need_service | crashy \
                 | (complete & (b + 1 > find_b2 + 1))
             return (b2, stop2, incomplete | need_service, find_b2, m,
-                    agg_c3, agg_e3, sts2, flags2, ctrs2, nw2, prev2, cur2)
+                    agg_c3, agg_e3, sts2, flags2, ctrs2, nw2, prev2,
+                    cur2, tabst, cnt, dstats)
 
+        if devdec_on:
+            tabst0 = (tab.hash_tab, tab.rip_l, tab.meta_i32, tab.meta_u64)
+            cnt0 = count0
+        else:
+            # devdec off: zero-size sentinels keep ONE carry structure
+            tabst0 = ()
+            cnt0 = jnp.int32(-1)
+        dstats0 = jnp.zeros((4,), jnp.int32)
         init = (jnp.int32(0), jnp.bool_(False), jnp.bool_(False),
                 jnp.int32(B), machine, agg_cov, agg_edge, statuses0,
-                flags0, ctrs0, nw0, snap0, snap0)
+                flags0, ctrs0, nw0, snap0, snap0, tabst0, cnt0, dstats0)
         (b, _stop, incomplete, _fb, m, agg_c, agg_e, sts, flags, ctrs,
-         nw, prev, cur) = lax.while_loop(cond, body, init)
+         nw, prev, cur, tabst, cnt, dstats) = lax.while_loop(
+            cond, body, init)
+        tab_out = (tab._replace(hash_tab=tabst[0], rip_l=tabst[1],
+                                meta_i32=tabst[2], meta_u64=tabst[3])
+                   if devdec_on else tab)
         return MegaOut(machine=m, agg_cov=agg_c, agg_edge=agg_e,
                        batches=b, incomplete=incomplete, statuses=sts,
                        new_flags=flags, ctr_sums=ctrs, new_words=nw,
-                       prev=prev, cur=cur)
+                       prev=prev, cur=cur, tab=tab_out, count=cnt,
+                       dd_stats=dstats)
+
+    if devdec_on:
+        def window(tab, image, machine, template, slab_first, slab_rest,
+                   seeds, pfns, gva_l, finish_l, limit, n_batches,
+                   agg_cov, agg_edge, count, bp_keys, n_bp):
+            return _window(tab, image, machine, template, slab_first,
+                           slab_rest, seeds, pfns, gva_l, finish_l,
+                           limit, n_batches, agg_cov, agg_edge,
+                           (count, bp_keys, n_bp))
+    else:
+        def window(tab, image, machine, template, slab_first, slab_rest,
+                   seeds, pfns, gva_l, finish_l, limit, n_batches,
+                   agg_cov, agg_edge):
+            return _window(tab, image, machine, template, slab_first,
+                           slab_rest, seeds, pfns, gva_l, finish_l,
+                           limit, n_batches, agg_cov, agg_edge, None)
 
     return window
 
 
 def make_megachunk(max_batches: int, n_pages: int, len_gpr: int,
-                   ptr_gpr: int, rounds: int, deliver: bool):
+                   ptr_gpr: int, rounds: int, deliver: bool,
+                   devdec: bool = False):
     """Build (or fetch) the jitted single-device megachunk window:
     (tab, image, machine, template, slab_first, slab_rest, seeds[B,L,2],
-    pfns, gva_l, finish, limit, n_batches, agg_cov, agg_edge) -> MegaOut.
+    pfns, gva_l, finish, limit, n_batches, agg_cov, agg_edge
+    [, count, bp_keys, n_bp when devdec]) -> MegaOut.
 
     No donation: the CPU stand-in is where tier-1 runs this (donation is
     unsound on XLA CPU, step.make_run_chunk's caveat), and the first
     hardware window will revisit the policy with the rest of the
     donation ledger."""
     key = ("1dev", max_batches, n_pages, len_gpr, ptr_gpr, rounds,
-           deliver)
+           deliver, devdec)
     cached = _MEGA_CACHE.get(key)
     if cached is not None:
         return cached
@@ -259,19 +387,25 @@ def make_megachunk(max_batches: int, n_pages: int, len_gpr: int,
 
     body = _make_body(max_batches, n_pages, len_gpr, ptr_gpr, rounds,
                       deliver, merge_fn=merge_coverage, any_fn=jnp.any,
-                      sum_fn=sum_fn)
+                      sum_fn=sum_fn, devdec_on=devdec)
     fn = jax.jit(body)
     _MEGA_CACHE[key] = fn
     return fn
 
 
 def make_mesh_megachunk(max_batches: int, n_pages: int, len_gpr: int,
-                        ptr_gpr: int, rounds: int, deliver: bool, mesh):
+                        ptr_gpr: int, rounds: int, deliver: bool, mesh,
+                        devdec: bool = False):
     """The megachunk window per shard under shard_map: machine/template/
     seed-stream/snapshots lane-sharded, slabs + uop table + aggregates
     replicated, the per-batch merge the shard-aware prefix-credit core,
     and every loop-control scalar all-reduced so the shards' while_loops
-    stay in lockstep (identical trip counts, matched collectives)."""
+    stay in lockstep (identical trip counts, matched collectives).
+
+    With `devdec`, decode-miss service rounds all-gather the per-shard
+    lane blocks and run ONE replicated sequential commit, so the table
+    (and its slot/coverage-bit order) stays bit-identical on every shard
+    AND to the single-device program — slots never partition by shard."""
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -279,7 +413,7 @@ def make_mesh_megachunk(max_batches: int, n_pages: int, len_gpr: int,
     from wtf_tpu.meshrun.mesh import LANE_AXIS
 
     key = ("mesh", max_batches, n_pages, len_gpr, ptr_gpr, rounds,
-           deliver, mesh)
+           deliver, mesh, devdec)
     cached = _MEGA_CACHE.get(key)
     if cached is not None:
         return cached
@@ -295,20 +429,32 @@ def make_mesh_megachunk(max_batches: int, n_pages: int, len_gpr: int,
         return mesh_merge_local(agg_cov, agg_edge, cov, edge, include,
                                 LANE_AXIS)
 
+    def gather_fn(a):
+        return lax.all_gather(a, LANE_AXIS, axis=0, tiled=True)
+
+    def lane_base_fn(n_local):
+        return lax.axis_index(LANE_AXIS).astype(jnp.int32) * n_local
+
     body = _make_body(max_batches, n_pages, len_gpr, ptr_gpr, rounds,
                       deliver, merge_fn=merge_fn, any_fn=any_fn,
-                      sum_fn=sum_fn)
+                      sum_fn=sum_fn, devdec_on=devdec,
+                      gather_fn=gather_fn if devdec else None,
+                      lane_base_fn=lane_base_fn if devdec else None)
     lane_snap = MegaSnap(words=P(LANE_AXIS), lens=P(LANE_AXIS))
+    in_specs = (P(), IMAGE_SPEC, P(LANE_AXIS), P(LANE_AXIS),
+                (P(), P(), P()), (P(), P(), P()), P(None, LANE_AXIS),
+                P(), P(), P(), P(), P(), P(), P())
+    if devdec:
+        in_specs = in_specs + (P(), P(), P())
     fn = jax.jit(shard_map(
         body, mesh=mesh,
-        in_specs=(P(), IMAGE_SPEC, P(LANE_AXIS), P(LANE_AXIS),
-                  (P(), P(), P()), (P(), P(), P()), P(None, LANE_AXIS),
-                  P(), P(), P(), P(), P(), P(), P()),
+        in_specs=in_specs,
         out_specs=MegaOut(
             machine=P(LANE_AXIS), agg_cov=P(), agg_edge=P(),
             batches=P(), incomplete=P(), statuses=P(None, LANE_AXIS),
             new_flags=P(None, LANE_AXIS), ctr_sums=P(), new_words=P(),
-            prev=lane_snap, cur=lane_snap),
+            prev=lane_snap, cur=lane_snap, tab=P(), count=P(),
+            dd_stats=P()),
         check_rep=False))
     _MEGA_CACHE[key] = fn
     return fn

@@ -196,19 +196,20 @@ def _build_kernel(k_steps: int, n_fields: int, hash_size: int,
 
         def probe(rip_l):
             """uop_lookup's open-addressed probe, one slot at a time (the
-            scalar gather emulation of the XLA path's 8-slot gather pair;
+            scalar gather emulation of the XLA path's 8-slot row gather;
             first live match wins, same result by insertion uniqueness).
-            Probes the tenant-tagged key, like step_lane."""
+            Probes the tenant-tagged key, like step_lane — the key limbs
+            ride in the hash row, so no dependent rip_l chase."""
             key_l = (rip_l[0], rip_l[1] ^ ttag)
             h_lo, _ = L.splitmix64(key_l)
 
             def body(k, found):
                 slot = ((h_lo + _u32(0) + k.astype(jnp.uint32))
                         & _u32(hmask)).astype(jnp.int32)
-                e = hash_ref[slot]
-                ec = jnp.maximum(e, 0)
-                ok = ((e >= 0) & (trip_ref[ec, 0] == key_l[0])
-                      & (trip_ref[ec, 1] == key_l[1]))
+                e = hash_ref[slot, 0]
+                ok = ((e >= 0)
+                      & (hash_ref[slot, 1].astype(jnp.uint32) == key_l[0])
+                      & (hash_ref[slot, 2].astype(jnp.uint32) == key_l[1]))
                 return jnp.where((found < 0) & ok, e, found)
 
             return lax.fori_loop(0, PROBES, body, jnp.int32(-1))
@@ -741,7 +742,7 @@ def make_run_fused(k_steps: int, interpret: Optional[bool] = None):
             kernel,
             grid=(n_lanes,),
             in_specs=[
-                full((hash_size,)),
+                full((hash_size, 3)),
                 full((capacity, 2)),
                 full((capacity, n_fields)),
                 full((capacity, 8)),

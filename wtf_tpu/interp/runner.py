@@ -631,6 +631,7 @@ class Runner:
         burst_any_tier: Optional[bool] = None,
         tenants=None,
         supervisor: Optional[Supervisor] = None,
+        device_decode: bool = False,
     ):
         # Telemetry: metrics registry (private unless the backend/CLI hands
         # in a shared one) + JSONL event sink (NULL swallows when unwired)
@@ -711,6 +712,13 @@ class Runner:
         self.fused_k = fused_k
         self.fused_rounds = fused_rounds
         self.fused_resume_steps = fused_resume_steps
+        # Device-resident x86 decode (interp/devdec): megachunk windows
+        # service decode misses in-graph and host servicing rounds pull
+        # only the missing lanes' code windows instead of full page
+        # views.  The host decoder stays the authoritative oracle: every
+        # device-published entry is re-decoded and cross-checked at
+        # harvest (uoptable.adopt_device_entries).
+        self.device_decode = device_decode
         if self.fused_enabled:
             from wtf_tpu.interp.pstep import fused_available
 
@@ -765,7 +773,8 @@ class Runner:
         self.stats = StatsDict(
             self.registry, "runner",
             fields=("chunks", "decodes", "decodes_prefetched",
-                    "fallbacks", "fallback_burst_steps", "smc_updates",
+                    "decode_windows_gathered", "fallbacks",
+                    "fallback_burst_steps", "smc_updates",
                     "bp_dispatches", "exceptions_delivered"),
             gauges=("max_chunk_steps",),
             labeled=("fallbacks_by_opclass",))
@@ -818,7 +827,26 @@ class Runner:
         from wtf_tpu.fuzz.megachunk import make_megachunk
 
         return make_megachunk(max_batches, n_pages, len_gpr, ptr_gpr,
-                              rounds, deliver=self.deliver_exceptions)
+                              rounds, deliver=self.deliver_exceptions,
+                              devdec=self.device_decode)
+
+    def devdec_operands(self) -> Tuple:
+        """Extra megachunk operands for the in-graph decoder: the live
+        cache count plus the pending-breakpoint key vector, padded to a
+        pow2 bucket (0 is a VALID key, so the live length rides along).
+        Empty tuple when --device-decode is off, so dispatch sites can
+        always splat it."""
+        if not self.device_decode:
+            return ()
+        keys = sorted(self.cache.pending_bps)
+        bucket = 8
+        while bucket < len(keys):
+            bucket *= 2
+        padded = np.zeros(bucket, dtype=np.uint64)
+        for j, k in enumerate(keys):
+            padded[j] = np.uint64(k)
+        return (jnp.int32(self.cache.count), jnp.asarray(padded),
+                jnp.int32(len(keys)))
 
     def megachunk_place(self, slab_first, slab_rest, seeds):
         """Placement hook for one window's operands — identity on a
@@ -974,13 +1002,24 @@ class Runner:
             view.pending.clear()
 
     # -- servicing ---------------------------------------------------------
-    def _decode_at(self, view: HostView, lane: int, rip: int) -> bool:
+    def _decode_at(self, view: HostView, lane: int, rip: int,
+                   prefetched=None) -> bool:
         """Decode the instruction at `rip` through `lane`'s memory view and
-        publish it.  Returns False on hard failure (lane made terminal)."""
-        try:
-            window = view.virt_read(lane, rip, 15)
-            pfn0 = view.translate(lane, rip) >> PAGE_SHIFT
-        except HostFault:
+        publish it.  Returns False on hard failure (lane made terminal).
+
+        `prefetched` is an optional (window, fault, pfn0, pfn14) tuple
+        from the device window gather (--device-decode): same bytes,
+        same fault/pfn facts, no HostView page pulls for the fetch."""
+        if prefetched is not None:
+            window, faulted, pfn0, pfn14 = prefetched
+        else:
+            try:
+                window = view.virt_read(lane, rip, 15)
+                pfn0 = view.translate(lane, rip) >> PAGE_SHIFT
+                faulted = False
+            except HostFault:
+                faulted = True
+        if faulted:
             self.lane_errors[lane] = f"fetch fault @ {rip:#x}"
             # host-detected fault: mirror the device's CTR_MEM_FAULT
             # accounting (a device page walk would have counted it)
@@ -990,10 +1029,19 @@ class Runner:
             view.r["fault_write"][lane] = np.int32(0)
             return False
         uop = decode(window, rip)
-        try:
-            pfn1 = view.translate(lane, rip + max(uop.length - 1, 0)) >> PAGE_SHIFT
-        except HostFault:
-            pfn1 = pfn0
+        if prefetched is not None:
+            # a successful 15-byte window read guarantees the last
+            # instruction byte translates; its frame is pfn0 unless the
+            # instruction itself crosses into the window's second page
+            crosses = (rip & (PAGE_SIZE - 1)) + max(uop.length - 1, 0) \
+                >= PAGE_SIZE
+            pfn1 = pfn14 if crosses else pfn0
+        else:
+            try:
+                pfn1 = view.translate(
+                    lane, rip + max(uop.length - 1, 0)) >> PAGE_SHIFT
+            except HostFault:
+                pfn1 = pfn0
         self.cache.add(rip, uop, pfn0, pfn1, tenant=self.tenant_of(lane))
         self.stats["decodes"] += 1
         self._prefetch_block(view, lane, uop, rip)
@@ -1056,16 +1104,57 @@ class Runner:
             work.extend(succs(u2, at))
 
     def _service_decode(self, view: HostView, lanes: List[int]) -> None:
+        windows = (self._gather_code_windows(view, lanes)
+                   if self.device_decode else {})
         done: Set[Tuple[int, int]] = set()
         for lane in lanes:
             rip = view.get_rip(lane)
             key = (self.tenant_of(lane), rip)
             if key not in done:
                 if not self.cache.has(rip, key[0]):
-                    if not self._decode_at(view, lane, rip):
+                    if not self._decode_at(view, lane, rip,
+                                           prefetched=windows.get(lane)):
                         continue
                 done.add(key)
             view.set_status(lane, StatusCode.RUNNING)
+
+    def _gather_code_windows(self, view: HostView, lanes: List[int]):
+        """--device-decode satellite: ONE device dispatch gathers the
+        missing lanes' 15-byte code windows (plus fault/pfn walk facts)
+        through the in-kernel page walk + overlay probe, so servicing a
+        decode miss transfers k x 15 bytes instead of riding the full
+        HostView page-pull path.  Lanes whose rip is already cached (or
+        duplicated within the round) are skipped on host before the
+        gather."""
+        from wtf_tpu.interp import devdec
+
+        want: List[int] = []
+        seen: Set[Tuple[int, int]] = set()
+        for lane in lanes:
+            rip = view.get_rip(lane)
+            key = (self.tenant_of(lane), rip)
+            if key in seen or self.cache.has(rip, key[0]):
+                continue
+            seen.add(key)
+            want.append(lane)
+        if not want:
+            return {}
+        # pow2 bucket bounds jit re-specialization like push()'s writes
+        bucket = 8
+        while bucket < len(want):
+            bucket *= 2
+        idx = np.zeros(bucket, dtype=np.int32)
+        idx[:len(want)] = want
+        m = self.machine
+        out = self.supervisor.dispatch(
+            "device-decode", devdec.gather_windows, self.image,
+            m.overlay, m.cr3, jnp.asarray(view.r["rip"]),
+            jnp.asarray(idx), sync=lambda o: o[1])
+        wins, faults, pfn0s, pfn14s = jax.device_get(out)
+        self.stats["decode_windows_gathered"] += len(want)
+        return {lane: (wins[j].tobytes(), bool(faults[j]),
+                       int(pfn0s[j]), int(pfn14s[j]))
+                for j, lane in enumerate(want)}
 
     def _service_smc(self, view: HostView, lanes: List[int]) -> None:
         for lane in lanes:

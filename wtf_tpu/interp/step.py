@@ -589,20 +589,26 @@ def _pack_pair(b16):
 
 def uop_lookup(tab: UopTable, rip_l):
     """Open-addressed probe (host inserter bounds chains to PROBES) ->
-    entry index or -1 (NEED_DECODE).  All PROBES slots are fetched in one
-    gather pair (probe count is a latency, not a work, concern on TPU).
+    entry index or -1 (NEED_DECODE).  All PROBES slots are fetched in ONE
+    gather — the hash rows carry the probe key's limbs next to the entry
+    index ([hash_size, 3]), so the verification compare reads the same
+    [PROBES, 3] block instead of chasing entry indices through a second
+    dependent gather of rip_l (probe count is a latency, not a work,
+    concern on TPU; dependent gathers are both).
 
     Ported path: `rip_l` is a u32 limb pair and the whole probe — the
-    splitmix64 hash, the slot indices, the rip verification compare — is
+    splitmix64 hash, the slot indices, the key verification compare — is
     u32-only (the table mask always fits 32 bits, so slot = (hash + k) &
     mask needs only the low hash limb)."""
     hmask = jnp.uint32(tab.hash_tab.shape[0] - 1)
     h_lo, _h_hi = L.splitmix64(rip_l)
     slots = ((h_lo + jnp.arange(PROBES, dtype=jnp.uint32))
              & hmask).astype(jnp.int32)
-    e = tab.hash_tab[slots]
-    er = tab.rip_l[jnp.maximum(e, 0)]
-    match = (e >= 0) & (er[:, 0] == rip_l[0]) & (er[:, 1] == rip_l[1])
+    rows = tab.hash_tab[slots]
+    e = rows[:, 0]
+    match = ((e >= 0)
+             & (rows[:, 1].astype(jnp.uint32) == rip_l[0])
+             & (rows[:, 2].astype(jnp.uint32) == rip_l[1]))
     # first-match via i32 min-rank (argmax's reduce runs an s64 iota under
     # x64, which would be the probe's only 64-bit op)
     rank = jnp.where(match, jnp.arange(PROBES, dtype=jnp.int32),

@@ -17,10 +17,17 @@ Contents per entry (capacity rows):
                    equivalent of the reference's 0xcc patching +
                    `SetBreakpoint`, reference src/wtf/backend.h:231)
 
-Lookup is open-addressed linear probing over `hash_tab` (slot -> entry index
-or -1), probe sequence splitmix64(rip) + k for k < PROBES.  The host inserter
-enforces the same probe bound, so a device miss <=> rip genuinely undecoded,
-surfacing as per-lane NEED_DECODE status for the runner to service.
+Lookup is open-addressed linear probing over `hash_tab` (slot -> [entry
+index or -1, probe-key limbs]), probe sequence splitmix64(rip) + k for
+k < PROBES.  The key limbs ride IN the hash row so a probe is ONE gather
+of an [8, 3] block — entry index and verification key land together,
+instead of a second gather through rip_l (which stays for the
+checkpoint/debug paths).  The host inserter enforces the same probe
+bound, so a device miss <=> rip genuinely undecoded, surfacing as
+per-lane NEED_DECODE status for the runner to service — and, under
+--device-decode, serviced in-graph by interp/devdec.py, with
+`adopt_device_entries` back-filling and cross-checking every
+device-published row against this host decoder at harvest.
 """
 
 from __future__ import annotations
@@ -77,7 +84,7 @@ class UopTable(NamedTuple):
     rip_l: jax.Array     # uint32[capacity, 2] (probe verification, LE limbs)
     meta_i32: jax.Array  # int32[capacity, NF + 3]: Uop fields, pfn0, pfn1, bp
     meta_u64: jax.Array  # uint64[capacity, 4]: disp, imm, raw_lo, raw_hi
-    hash_tab: jax.Array  # int32[hash_size]; entry index or -1
+    hash_tab: jax.Array  # int32[hash_size, 3]: entry index or -1, key limbs
 
 
 # meta_i32 column layout (first NF columns are uops.INT_FIELDS)
@@ -262,6 +269,56 @@ class DecodeCache:
             tenant = int(entry[4]) if len(entry) > 4 else 0
             self.add(rip, decode(raw, rip), pfn0, pfn1, tenant=tenant)
 
+    # -- device-published entry adoption (interp/devdec.py harvest) ------
+    def adopt_device_entries(self, rip_l, meta_i32, meta_u64,
+                             start: int, end: int) -> int:
+        """Back-fill rows [start, end) that the device decoder published
+        during a megachunk window, in publish order, so host and device
+        tables keep identical entry indices (coverage bit i IS entry
+        index i).  The arrays are the [start, end) SLICE of the device
+        table (row 0 == entry `start`) so the harvest transfers only the
+        published rows, not the whole capacity.  The host decoder stays
+        the authoritative oracle: every row is re-decoded from its raw
+        bytes and cross-checked field for field; the HOST result is what
+        gets stored.  Returns the number of rows whose device decode
+        disagreed (must be 0 — any nonzero count is a devdec bug,
+        surfaced by the caller's counter).
+        """
+        from wtf_tpu.cpu.decoder import decode
+
+        if start != self.count:
+            raise RuntimeError(
+                f"device-entry adoption out of order: device rows start "
+                f"at {start}, host cache has {self.count}")
+        rip_l = np.asarray(rip_l)
+        meta_i32 = np.asarray(meta_i32)
+        meta_u64 = np.asarray(meta_u64)
+        mismatches = 0
+        for idx in range(end - start):
+            key = (int(rip_l[idx, 0]) & 0xFFFFFFFF) | (
+                (int(rip_l[idx, 1]) & 0xFFFFFFFF) << 32)
+            # untag: canonical rips carry bits 63:48 as copies of bit 47
+            # (bit 47 is below the tag, so it survives tagging intact)
+            tenant = (key >> 48) ^ (0xFFFF if (key >> 47) & 1 else 0)
+            rip = tag_key(key, tenant)
+            length = max(int(meta_i32[idx, F_LENGTH]), 0)
+            raw = (int(meta_u64[idx, MU_RAW_LO]).to_bytes(8, "little")
+                   + int(meta_u64[idx, MU_RAW_HI]).to_bytes(8, "little")
+                   )[:length]
+            uop = decode(raw, rip)
+            bad = any(
+                int(meta_i32[idx, f]) != int(getattr(uop, name))
+                for f, name in enumerate(INT_FIELDS))
+            bad = bad or int(meta_u64[idx, MU_DISP]) != (uop.disp & _MASK64)
+            bad = bad or int(meta_u64[idx, MU_IMM]) != (uop.imm & _MASK64)
+            bad = bad or int(meta_i32[idx, M_BP]) != (
+                1 if key in self.pending_bps else 0)
+            if bad:
+                mismatches += 1
+            self.add(rip, uop, int(meta_i32[idx, M_PFN0]),
+                     int(meta_i32[idx, M_PFN1]), tenant=tenant)
+        return mismatches
+
     # -- breakpoints -----------------------------------------------------
     def set_breakpoint(self, gva: int, tenant: int = 0) -> None:
         key = tag_key(gva, tenant)
@@ -291,11 +348,21 @@ class DecodeCache:
                  self.bp[:, None]], axis=1)
             meta_u64 = np.stack(
                 [self.disp, self.imm, self.raw_lo, self.raw_hi], axis=1)
+            # hash rows carry the probe key's u32 limbs alongside the
+            # entry index (one [PROBES, 3] gather per lookup)
+            occ = self.hash_tab >= 0
+            keys = self.rip[np.maximum(self.hash_tab, 0)]
+            klo = np.where(occ, keys & np.uint64(0xFFFFFFFF), 0)
+            khi = np.where(occ, keys >> np.uint64(32), 0)
+            rows = np.stack(
+                [self.hash_tab,
+                 klo.astype(np.uint32).view(np.int32),
+                 khi.astype(np.uint32).view(np.int32)], axis=1)
             self._device = UopTable(
                 rip_l=jnp.asarray(unpack_np(self.rip)),
                 meta_i32=jnp.asarray(meta_i32),
                 meta_u64=jnp.asarray(meta_u64),
-                hash_tab=jnp.asarray(self.hash_tab),
+                hash_tab=jnp.asarray(rows),
             )
         return self._device
 

@@ -141,7 +141,8 @@ class MeshRunner(Runner):
         return make_mesh_megachunk(max_batches, n_pages, len_gpr,
                                    ptr_gpr, rounds,
                                    deliver=self.deliver_exceptions,
-                                   mesh=self.mesh)
+                                   mesh=self.mesh,
+                                   devdec=self.device_decode)
 
     def megachunk_place(self, slab_first, slab_rest, seeds):
         """Place one window's operands: slabs replicated (version-

@@ -61,7 +61,8 @@ SEAM_SITES: Dict[str, str] = {
     "fused-resume": "wtf_tpu.interp.runner:Runner._fused_dispatch",
     "device-insert": "wtf_tpu.interp.runner:Runner.device_insert",
     "devmut-generate": "wtf_tpu.devmut.mutator:DevMangleMutator.generate",
-    "megachunk": "wtf_tpu.backend.tpu:TpuBackend.run_megachunk",
+    "megachunk": "wtf_tpu.backend.tpu:TpuBackend._dispatch_window",
+    "device-decode": "wtf_tpu.interp.runner:Runner._gather_code_windows",
 }
 SUPERVISED_SEAMS = tuple(sorted(SEAM_SITES))
 

@@ -1,0 +1,86 @@
+"""Device-decode smoke (`make decode-smoke`, wired into `make verify`).
+
+The zero-host-steady-state acceptance drill, CPU-only, no hardware: a
+COLD-CACHE demo_tlv devmangle campaign with `--device-decode` must
+
+  * complete its megachunk windows with ZERO host decode services —
+    every decode-cache miss (cold start included) serviced in-graph,
+    the host decoder running only as the harvest cross-check oracle;
+  * cross-check CLEAN: every device-published entry byte-identical to
+    the host decoder (mismatch counter == 0);
+  * stay bit-identical to the host-serviced reference at equal seeds —
+    coverage/edge bitmap bytes, corpus digests, crash buckets, decode
+    cache entry INDICES (the coverage-bit mapping);
+  * overlap harvest with execution: steady-state windows prelaunch, and
+    at least one speculative window is adopted.
+
+Exit 0 = all held; any assertion prints and exits 1.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _leg() -> None:
+    from wtf_tpu.analysis.trace import build_tlv_campaign
+    from wtf_tpu.utils.hashing import hex_digest
+
+    def campaign(**kw):
+        loop = build_tlv_campaign(
+            mutator="devmangle", seed=0x5EED, megachunk=4, n_lanes=4,
+            limit=10_000, chunk_steps=128, overlay_slots=16, **kw)
+        # long enough that finds land in-graph AND steady-state windows
+        # (complete, find-free) exist for the prelaunch to ride
+        loop.fuzz(runs=4 * 16)
+        cov, edge = loop.backend.coverage_state()
+        return loop, {
+            "cov": cov.tobytes(), "edge": edge.tobytes(),
+            "corpus": [hex_digest(d) for d in loop.corpus],
+            "buckets": sorted(loop.crash_buckets),
+            "testcases": loop.stats.testcases,
+            "crashes": loop.stats.crashes,
+            "timeouts": loop.stats.timeouts,
+            "entries": loop.backend.runner.cache.checkpoint_entries(),
+        }
+
+    ref_loop, ref = campaign()
+    dd_loop, dd = campaign(device_decode=True)
+    for key in ref:
+        assert dd[key] == ref[key], (
+            f"--device-decode diverged from the host-serviced "
+            f"reference on {key}")
+    reg = dd_loop.backend.registry
+    published = reg.counter("devdec.published").value
+    mismatches = reg.counter("devdec.crosscheck_mismatches").value
+    host_decodes = dd_loop.backend.runner.stats["decodes"]
+    zero_windows = reg.counter("devdec.zero_host_windows").value
+    windows = reg.counter("megachunk.windows").value
+    hits = reg.counter("megachunk.prelaunch_hits").value
+    assert published > 0, "no device-published decode entries"
+    assert mismatches == 0, (
+        f"{mismatches} device entries disagreed with the host decoder")
+    assert host_decodes == 0, (
+        f"{host_decodes} host decode services in a --device-decode "
+        f"campaign — the zero-host window broke")
+    assert zero_windows > 0, "no zero-host windows recorded"
+    assert hits > 0, "pipelined harvest never adopted a prelaunch"
+    print(f"[decode-smoke] zero-host steady state held: "
+          f"{published} entries device-published, cross-check clean, "
+          f"0 host decode services ({ref_loop.backend.runner.stats['decodes']} "
+          f"in the reference), {zero_windows}/{windows} zero-host "
+          f"windows, {hits} prelaunch adoptions")
+
+
+def main() -> int:
+    try:
+        _leg()
+    except AssertionError as e:
+        print(f"[decode-smoke] FAILED: {e}")
+        return 1
+    print("[decode-smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
