@@ -8,10 +8,10 @@ PY ?= python
 
 .PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke \
 	triage-smoke tenancy-smoke fleet-smoke fused-smoke \
-	device-chaos-smoke decode-smoke
+	device-chaos-smoke decode-smoke obs-smoke bench-guard
 
 verify: test lint chaos-smoke triage-smoke tenancy-smoke fleet-smoke \
-	fused-smoke device-chaos-smoke decode-smoke
+	fused-smoke device-chaos-smoke decode-smoke obs-smoke bench-guard
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -94,3 +94,20 @@ device-chaos-smoke:
 # and stay bit-identical to the host-serviced reference
 decode-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.decode_smoke
+
+# observability smoke (wtf_tpu/testing/obs_smoke): a real master + 4
+# WTF3 sim clients under scripted faults and re-sent TAG_TELEM frames —
+# the fleet aggregate must be byte-equal to the serial sum of node
+# snapshots — plus one campaign run producing a schema-valid Chrome
+# trace (>=1 fenced device span, >=1 megachunk window) and a rendering
+# `wtf-tpu status` surface
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.obs_smoke
+
+# perf-regression guard self-test (tools/bench_guard.py): extraction
+# over the checked-in BENCH_r06/r07 rounds must compare clean while a
+# synthetic 2x regression must be flagged.  To gate a fresh run:
+#   python bench.py > /tmp/bench.json && \
+#   python tools/bench_guard.py /tmp/bench.json
+bench-guard:
+	$(PY) tools/bench_guard.py --self-test

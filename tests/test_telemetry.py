@@ -200,6 +200,45 @@ def test_event_log_skips_torn_tail(tmp_path):
     assert len(records) == 1
 
 
+def test_event_log_rotates_at_size_cap(tmp_path):
+    """Size-based rotation: events.jsonl -> events.jsonl.1, exactly one
+    generation of history, and read_events(rotated=True) replays both
+    generations oldest-first."""
+    path = tmp_path / "events.jsonl"
+    with EventLog(path, max_bytes=400) as log:
+        for i in range(40):
+            log.emit("tick", n=i)
+    rotated = tmp_path / "events.jsonl.1"
+    assert rotated.exists()
+    assert not (tmp_path / "events.jsonl.2").exists()  # one gen only
+    assert rotated.stat().st_size >= 400  # rotation fired AT the cap
+    current = [r["n"] for r in read_events(path)]
+    merged = [r["n"] for r in read_events(path, rotated=True)]
+    assert len(current) < 40  # the cap actually bounded the live file
+    # both generations parse, in order, ending at the newest record;
+    # older rotated-away generations are the deliberate loss
+    assert merged == list(range(merged[0], 40))
+    assert merged[:len(merged) - len(current)] + current == merged
+
+
+def test_event_log_torn_tail_survives_rotation(tmp_path):
+    """A killed run can freeze a torn line into the generation that then
+    rotates to .1 — readers must skip it in EVERY generation (the
+    test_event_log_skips_torn_tail contract, extended to rotation)."""
+    path = tmp_path / "events.jsonl"
+    with EventLog(path, max_bytes=150) as log:
+        log.emit("run-start")
+        log._fh.write('{"ts": 1.0, "seq": 99, "type": "hea')  # torn
+        log._fh.flush()
+        # this record glues onto the torn tail (one unparseable line)
+        # and its size pushes the file past the cap -> rotation
+        log.emit("casualty", fill="x" * 200)
+        log.emit("after-rotation")
+    assert (tmp_path / "events.jsonl.1").exists()
+    types = [r["type"] for r in read_events(path, rotated=True)]
+    assert types == ["run-start", "after-rotation"]
+
+
 def test_null_event_log_swallows_everything(tmp_path):
     assert open_event_log(None) is NULL
     NULL.emit("crash", name="x")
@@ -253,6 +292,69 @@ def test_maybe_heartbeat_skips_line_fn_when_unobserved():
     assert stats.maybe_heartbeat(NULL, None, line_fn, every=0.0,
                                  print_stats=True) == "#0 line"
     assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# trace timeline (--trace-out)
+# ---------------------------------------------------------------------------
+
+def test_trace_collector_round_trip(tmp_path):
+    """Spans mirrored into a TraceCollector -> Chrome-trace JSON: exact
+    event count, µs durations, device/host categorization by fenced
+    leaf, child spans nested inside their parents, instants carried."""
+    from wtf_tpu.telemetry.spans import Spans, TraceCollector
+
+    reg = Registry()
+    clock = [100.0]  # non-zero epoch: ts must rebase to the first event
+    collector = TraceCollector(clock=lambda: clock[0])
+    spans = Spans(reg, clock=lambda: clock[0])
+    spans.collector = collector
+    with spans.span("execute"):
+        clock[0] += 1.0
+        with spans.span("device-step"):
+            clock[0] += 2.0
+    collector.instant("compile", {"chunk_steps": 64})
+    with spans.span("harvest"):
+        clock[0] += 0.5
+
+    n = collector.write(tmp_path / "trace.json")
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    events = doc["traceEvents"]
+    assert n == len(events) == 4
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["dropped_events"] == 0
+    by_name = {e["name"]: e for e in events}
+    dev = by_name["device-step"]
+    assert dev["ph"] == "X" and dev["cat"] == "device"
+    assert dev["dur"] == pytest.approx(2e6)  # µs
+    assert dev["args"]["path"] == "execute/device-step"
+    exe = by_name["execute"]
+    assert exe["cat"] == "host" and exe["dur"] == pytest.approx(3e6)
+    assert exe["ts"] == 0.0  # rebased epoch
+    # nesting: the child interval lies inside the parent interval
+    assert exe["ts"] <= dev["ts"]
+    assert dev["ts"] + dev["dur"] <= exe["ts"] + exe["dur"]
+    inst = by_name["compile"]
+    assert inst["ph"] == "i" and inst["cat"] == "event"
+    assert inst["args"]["chunk_steps"] == 64
+    # the registry totals are untouched by mirroring
+    assert reg.counter("phase.seconds").children["execute"].value == \
+        pytest.approx(3.0)
+
+
+def test_trace_collector_bounds_memory_by_dropping_oldest():
+    from wtf_tpu.telemetry.spans import TraceCollector
+
+    clock = [0.0]
+    collector = TraceCollector(clock=lambda: clock[0], max_events=10)
+    for i in range(25):
+        clock[0] += 1.0
+        collector.complete(f"p{i}", clock[0], 0.1)
+    events = collector.trace_events()
+    assert len(events) <= 10
+    assert collector.dropped == 25 - len(events)
+    # the survivors are the NEWEST events (steady state, not startup)
+    assert {e["name"] for e in events} <= {f"p{i}" for i in range(15, 25)}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Noise-aware perf-regression guard over the BENCH_r*.json trajectory.
+
+The repo's bench rounds (BENCH_r01..rNN, PERF.md) are recorded on
+cpu-shares-throttled CI containers where two back-to-back runs of the
+SAME tree differ by ~25% (BENCH_r06's note documents 14.1s vs 18.3s for
+chunk512) — a naive "slower than last round" gate would flap.  This
+guard compares a fresh `python bench.py` JSON (or any BENCH_r file)
+against the newest comparable checked-in round with that variance made
+explicit:
+
+  - a metric REGRESSES only when it worsens past the noise band
+    (default ±25%); inside the band it's OK, past the band the *other*
+    way it's an improvement
+  - the overall verdict fails only on >= 2 regressed metrics, or one
+    metric past the SQUARED band (beyond two stacked noise intervals —
+    not explainable as container luck), or any exact-metric increase
+  - deterministic metrics (the XLA kernel-count budget) get NO noise
+    band: any increase is a real step-graph regression (same ratchet
+    as `wtf-tpu lint --rebaseline`)
+
+Usage:
+  python tools/bench_guard.py <fresh.json>      # vs newest BENCH_r*
+  python tools/bench_guard.py <fresh.json> --baseline BENCH_r07.json
+  python tools/bench_guard.py --self-test       # guard logic on r06/r07
+  options: --noise 0.25  --json
+
+Exit 0 = no regression (or self-test pass), 1 = regression, 2 = usage /
+no comparable metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# direction per comparable metric; exact metrics ratchet with no band
+LOWER_BETTER = {
+    "micro.chunk512_wall_s",
+    "micro.chunk_dispatch_floor_s",
+    "megachunk.host_share_of_wall",
+}
+HIGHER_BETTER = {
+    "micro.branchy_instr_per_s",
+    "headline.execs_per_s",
+    "fused.occupancy",
+    "megachunk.execs_per_s",
+    "devmut.device_execs_per_s",
+}
+EXACT = {"budget.xla_step_total"}
+
+_MICRO_KEYS = ("branchy_instr_per_s", "chunk512_wall_s",
+               "chunk_dispatch_floor_s")
+
+
+def _num(value):
+    return value if isinstance(value, (int, float)) \
+        and not isinstance(value, bool) else None
+
+
+def extract(doc: dict) -> dict:
+    """Comparable {metric: value} rows from any bench shape the repo has
+    produced: a raw bench.py line, the r02-r05 harness wrapper
+    ({"parsed": ...}), or the hand-structured r06+ rounds."""
+    out = {}
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    micro = doc.get("microbench") or \
+        (doc.get("micro_compare") or {}).get("current") or {}
+    for key in _MICRO_KEYS:
+        value = _num(micro.get(key))
+        if value is not None:
+            out[f"micro.{key}"] = value
+    if str(doc.get("unit", "")) == "execs/s":
+        value = _num(doc.get("value"))
+        if value is not None:
+            out["headline.execs_per_s"] = value
+    fused = doc.get("fused_compare") or {}
+    occ = _num((fused.get("fused") or fused.get("fused_on") or {})
+               .get("fused_occupancy"))
+    if occ is not None:
+        out["fused.occupancy"] = occ
+    mega = (doc.get("megachunk_host_share") or {}).get("megachunk") or {}
+    for src, dst in (("execs_per_s", "megachunk.execs_per_s"),
+                     ("host_share_of_wall",
+                      "megachunk.host_share_of_wall")):
+        value = _num(mega.get(src))
+        if value is not None:
+            out[dst] = value
+    devmut = (doc.get("devmut_ab") or {}).get("device") or {}
+    value = _num(devmut.get("execs_per_s"))
+    if value is not None:
+        out["devmut.device_execs_per_s"] = value
+    value = _num((doc.get("kernel_budget") or {}).get("xla_step_total"))
+    if value is not None:
+        out["budget.xla_step_total"] = value
+    return out
+
+
+def compare(baseline: dict, fresh: dict, noise: float = 0.25) -> dict:
+    """Per-metric verdicts over the shared keys + the overall verdict."""
+    rows = {}
+    regressed = []
+    hard = []
+    for name in sorted(set(baseline) & set(fresh)):
+        base, cur = baseline[name], fresh[name]
+        if name in EXACT:
+            verdict = "regressed" if cur > base else (
+                "improved" if cur < base else "ok")
+            if verdict == "regressed":
+                regressed.append(name)
+                hard.append(name)  # deterministic: no noise excuse
+            rows[name] = {"baseline": base, "current": cur,
+                          "verdict": verdict, "exact": True}
+            continue
+        ratio = cur / base if base else float("inf")
+        worse = ratio > 1.0 + noise if name in LOWER_BETTER \
+            else ratio < 1.0 - noise
+        better = ratio < 1.0 - noise if name in LOWER_BETTER \
+            else ratio > 1.0 + noise
+        far = ratio > (1.0 + noise) ** 2 if name in LOWER_BETTER \
+            else ratio < (1.0 - noise) ** 2
+        verdict = "regressed" if worse else (
+            "improved" if better else "ok")
+        if worse:
+            regressed.append(name)
+            if far:
+                hard.append(name)
+        rows[name] = {"baseline": base, "current": cur,
+                      "ratio": round(ratio, 4), "verdict": verdict}
+    fail = len(regressed) >= 2 or bool(hard)
+    return {"noise": noise, "metrics": rows, "regressed": regressed,
+            "hard_regressions": hard, "compared": len(rows),
+            "fail": fail}
+
+
+def trajectory(baseline_path=None):
+    """(path, comparable rows) of the chosen baseline round: explicit
+    --baseline, else the newest BENCH_r* that yields >= 1 row."""
+    if baseline_path is not None:
+        path = Path(baseline_path)
+        return path, extract(json.loads(path.read_text()))
+    rounds = sorted(
+        REPO.glob("BENCH_r*.json"),
+        key=lambda p: int(re.sub(r"\D", "", p.stem) or 0), reverse=True)
+    for path in rounds:
+        rows = extract(json.loads(path.read_text()))
+        if rows:
+            return path, rows
+    return None, {}
+
+
+def self_test(noise: float) -> dict:
+    """The guard's own invariants, on the checked-in r06/r07 pair:
+    extraction finds the known metric rows, the real r06->r07 movement
+    produces no hard regression, and a synthetic 2x worsening of every
+    shared metric IS flagged."""
+    r06 = extract(json.loads((REPO / "BENCH_r06.json").read_text()))
+    r07 = extract(json.loads((REPO / "BENCH_r07.json").read_text()))
+    assert {"micro.branchy_instr_per_s", "micro.chunk512_wall_s",
+            "fused.occupancy",
+            "devmut.device_execs_per_s"} <= set(r06), \
+        f"r06 extraction incomplete: {sorted(r06)}"
+    assert {"fused.occupancy", "megachunk.execs_per_s",
+            "megachunk.host_share_of_wall",
+            "budget.xla_step_total"} <= set(r07), \
+        f"r07 extraction incomplete: {sorted(r07)}"
+    real = compare(r06, r07, noise)
+    assert real["compared"] >= 1, "r06/r07 share no comparable metric"
+    assert not real["hard_regressions"], \
+        (f"checked-in trajectory reads as a hard regression: "
+         f"{real['hard_regressions']} — the guard would flap on CI")
+    bad = {}
+    for name, value in r07.items():
+        if name in EXACT:
+            bad[name] = value + 1
+        elif name in LOWER_BETTER:
+            bad[name] = value * 2.0
+        else:
+            bad[name] = value / 2.0
+    synthetic = compare(r07, bad, noise)
+    assert synthetic["fail"], "synthetic 2x regression was NOT flagged"
+    assert set(synthetic["regressed"]) == set(bad), \
+        f"synthetic regression missed: {synthetic['regressed']}"
+    return {"real": real, "synthetic_flagged": synthetic["regressed"]}
+
+
+def _print_report(report: dict, base_path, fresh_path) -> None:
+    print(f"bench-guard: {fresh_path} vs {base_path} "
+          f"(noise band ±{report['noise'] * 100:.0f}%)")
+    for name, row in report["metrics"].items():
+        ratio = f" ({row['ratio']}x)" if "ratio" in row else " (exact)"
+        print(f"  {row['verdict']:<10} {name:<32} "
+              f"{row['baseline']} -> {row['current']}{ratio}")
+    if report["fail"]:
+        print(f"bench-guard FAIL: {len(report['regressed'])} "
+              f"regressed ({', '.join(report['regressed'])}; "
+              f"hard: {', '.join(report['hard_regressions']) or '-'})")
+    else:
+        print(f"bench-guard OK: {report['compared']} metric(s) "
+              f"compared, {len(report['regressed'])} inside-band "
+              f"regression(s)")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_guard", description=__doc__.splitlines()[0])
+    parser.add_argument("fresh", nargs="?", type=Path,
+                        help="fresh bench.py JSON (file with the "
+                             "bench line or a BENCH_r-shaped doc)")
+    parser.add_argument("--baseline", type=Path, default=None)
+    parser.add_argument("--noise", type=float, default=0.25)
+    parser.add_argument("--self-test", action="store_true")
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.self_test:
+        result = self_test(args.noise)
+        if args.json:
+            print(json.dumps(result))
+        else:
+            print(f"bench-guard self-test PASS "
+                  f"({result['real']['compared']} r06/r07 metric(s) "
+                  f"compared clean; synthetic regression flagged on "
+                  f"{len(result['synthetic_flagged'])} metric(s))")
+        return 0
+    if args.fresh is None:
+        parser.print_usage(sys.stderr)
+        return 2
+    text = args.fresh.read_text()
+    try:
+        fresh_doc = json.loads(text)
+    except ValueError:
+        # bench.py streams log lines before the one JSON line: take the
+        # last parseable line (same posture as the r02-r05 harness)
+        fresh_doc = None
+        for line in reversed(text.splitlines()):
+            try:
+                fresh_doc = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if fresh_doc is None:
+            print(f"bench-guard: no JSON in {args.fresh}",
+                  file=sys.stderr)
+            return 2
+    fresh = extract(fresh_doc)
+    base_path, baseline = trajectory(args.baseline)
+    if not fresh or not baseline:
+        print("bench-guard: no comparable metrics "
+              f"(fresh: {sorted(fresh)}, baseline: {sorted(baseline)})",
+              file=sys.stderr)
+        return 2
+    report = compare(baseline, fresh, args.noise)
+    if not report["compared"]:
+        print("bench-guard: fresh and baseline share no metric",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"baseline": str(base_path), **report}))
+    else:
+        _print_report(report, base_path, args.fresh)
+    return 1 if report["fail"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

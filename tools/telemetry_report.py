@@ -358,6 +358,11 @@ def summarize(path) -> dict:
         devdecode["harvest_overlap_share"] = (
             round(devdecode["prelaunch_hits"] / mega_windows, 4)
             if mega_windows else None)
+        # the PR-14 steady-state headline, as one number (also live on
+        # the heartbeat line as `zh:` and in `wtf-tpu status`)
+        devdecode["zero_host_window_rate"] = (
+            round(devdecode["zero_host_windows"] / mega_windows, 4)
+            if mega_windows else None)
 
     testcases = metrics.get("campaign.testcases", 0) or 0
     fallbacks = metrics.get("runner.fallbacks_by_opclass", {})
@@ -588,6 +593,8 @@ def _print_human(s: dict) -> None:
                  else f"{ddc['crosscheck_mismatches']} MISMATCHES")
         mean = (f", mean {ddc['zero_host_mean_batches']} batches"
                 if ddc.get("zero_host_mean_batches") is not None else "")
+        rate = (f" ({ddc['zero_host_window_rate'] * 100:.0f}% zero-host)"
+                if ddc.get("zero_host_window_rate") is not None else "")
         overlap = (f"{ddc['harvest_overlap_share'] * 100:.1f}%"
                    if ddc.get("harvest_overlap_share") is not None
                    else "n/a")
@@ -598,7 +605,7 @@ def _print_human(s: dict) -> None:
               f"rounds={ddc['service_rounds']} "
               f"host-services={ddc['host_decode_services']}")
         print(f"  zero-host windows: {ddc['zero_host_windows']}"
-              f"/{ddc['windows']}{mean}; harvest overlap {overlap} "
+              f"/{ddc['windows']}{rate}{mean}; harvest overlap {overlap} "
               f"(prelaunched {ddc['prelaunched']}, "
               f"adopted {ddc['prelaunch_hits']}, "
               f"dropped {ddc['prelaunch_dropped']})")

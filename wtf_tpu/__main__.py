@@ -1,7 +1,5 @@
 """`python -m wtf_tpu` -> CLI (wtf_tpu/cli.py)."""
 
-import sys
+from wtf_tpu.cli import console_main
 
-from wtf_tpu.cli import main
-
-sys.exit(main())
+console_main()

@@ -39,6 +39,14 @@ the result:
             has too few devices (plain `make lint`), the family re-runs
             itself in a subprocess with
             XLA_FLAGS=--xla_force_host_platform_device_count=8.
+  supervise every device dispatch entry point routes through the
+            Supervisor (wtf_tpu/supervise) — seam routing + enumeration
+            completeness, by source inspection over SEAM_SITES
+  telemetry no dispatch seam serializes the metric registry inline
+            (snapshot / encode_telem / json.dumps in a per-chunk path) —
+            the <1% observability-overhead bar holds because
+            serialization rides the heartbeat/TAG_TELEM cadence; same
+            SEAM_SITES enumeration as the supervise family
 
 `run_lint` orchestrates all families and reports Findings; helpers are
 public so tests can seed violations directly.
@@ -108,7 +116,8 @@ MESH_CONFIG = dict(n_steps=16, lanes_per_shard=2,
 DECODE_ENTRY = "decode_service"
 DECODE_BP_SLOTS = 8
 
-FAMILIES = ("dtype", "budget", "recompile", "parity", "mesh", "supervise")
+FAMILIES = ("dtype", "budget", "recompile", "parity", "mesh", "supervise",
+            "telemetry")
 
 _FORBID_64 = re.compile(r"\b(u64|s64|f64|f32)\[")
 # jaxpr primitives that move/reshape bits without computing on them (the
@@ -483,6 +492,57 @@ def check_supervised_seams(sites: Optional[Dict[str, str]] = None
                          "would bypass watchdog + rebuild-and-replay "
                          "recovery; route the call or update "
                          "supervise.SEAM_SITES")))
+    return findings
+
+
+# registry-serialization surface: building a wire/export payload from
+# the metric registry.  Counter bumps (`.inc()`, `.set()`) are O(1) dict
+# ops and welcome anywhere; these are O(registry) + JSON and are not.
+_TELEM_SERIALIZE = re.compile(
+    r"\.snapshot\(|encode_telem\(|render_prometheus\(|json\.dumps\(")
+
+
+def check_telemetry_seams(sites: Optional[Dict[str, str]] = None
+                          ) -> List[Finding]:
+    """No supervised dispatch seam may serialize the metric registry
+    inline: `.snapshot()` walks every metric, `encode_telem`/`json.dumps`
+    pay JSON, and the seams run once per chunk — the <1% overhead bar
+    (PERF.md) holds because serialization rides the heartbeat/TAG_TELEM
+    cadence (seconds) instead.  Statically, over the same
+    supervise.SEAM_SITES enumeration the routing rule walks, so a new
+    dispatch seam is covered the moment it is enumerated.  `sites`
+    parameterizes the enumeration for rule tests."""
+    import importlib
+    import inspect
+
+    if sites is None:
+        from wtf_tpu.supervise import SEAM_SITES
+
+        sites = SEAM_SITES
+    findings: List[Finding] = []
+    for seam, site in sorted(sites.items()):
+        mod_name, _, qual = site.partition(":")
+        try:
+            obj = importlib.import_module(mod_name)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            src = inspect.getsource(obj)
+        except Exception:
+            # unresolvable sites are the supervise family's finding;
+            # double-reporting here would just duplicate the signal
+            continue
+        hits = sorted({m.group(0) for m in _TELEM_SERIALIZE.finditer(src)})
+        if hits:
+            findings.append(Finding(
+                rule="telemetry.seam-serialization", entry=site,
+                primitive=f"{seam}: {', '.join(hits)}",
+                count=len(hits),
+                message=("dispatch seam serializes telemetry inline — "
+                         "registry snapshots / telem encoding are "
+                         "O(registry)+JSON and this seam runs per chunk; "
+                         "move the serialization to the heartbeat or "
+                         "TAG_TELEM cadence (counter bumps stay, "
+                         "serialization goes)")))
     return findings
 
 
@@ -1096,6 +1156,15 @@ def run_lint(families: Optional[Sequence[str]] = None,
         info["entries"].append(
             f"supervise.SEAM_SITES ({len(SEAM_SITES)} seams)")
         info["seconds"]["supervise"] = round(time.time() - t0, 1)
+
+    if "telemetry" in families:
+        t0 = time.time()
+        findings.extend(check_telemetry_seams())
+        from wtf_tpu.supervise import SEAM_SITES
+
+        info["entries"].append(
+            f"telemetry over SEAM_SITES ({len(SEAM_SITES)} seams)")
+        info["seconds"]["telemetry"] = round(time.time() - t0, 1)
 
     if rebaseline and measured_budgets:
         budgets = apply_rebaseline(load_budgets(budgets_path),

@@ -59,6 +59,13 @@ def _add_paths(p: argparse.ArgumentParser) -> None:
                         "run-start/heartbeat/new-coverage/crash/timeout/"
                         "compile/run-end records with a full metrics dump; "
                         "summarize with tools/telemetry_report.py)")
+    p.add_argument("--trace-out", type=Path, default=None,
+                   help="write a Chrome-trace-event timeline "
+                        "(chrome://tracing / Perfetto JSON) of every "
+                        "span — fenced device dispatches, compiles, "
+                        "megachunk windows — plus instant marks for "
+                        "point events (crash/new-coverage/checkpoint/"
+                        "recovery)")
 
 
 def _add_target_selection(p: argparse.ArgumentParser) -> None:
@@ -266,6 +273,15 @@ def build_parser() -> argparse.ArgumentParser:
                       help="content-addressed corpus/crash store root "
                            "(wtf_tpu/fleet/store); outputs//crashes/ "
                            "become flat views of it")
+    camp.add_argument("--xprof-dir", type=Path, default=None,
+                      help="capture ONE jax.profiler device trace over "
+                           "--xprof-batches steady-state batches (the "
+                           "first batches are compile/warmup and are "
+                           "skipped); open with xprof/tensorboard for "
+                           "kernel-level truth under the span timeline")
+    camp.add_argument("--xprof-batches", type=int, default=4,
+                      metavar="N",
+                      help="batches inside the --xprof-dir window")
     camp.add_argument("--coordinator", default=None,
                       help="jax.distributed coordinator address for a"
                            " multi-host launch (host:port)")
@@ -436,6 +452,21 @@ def build_parser() -> argparse.ArgumentParser:
     ffs.add_argument("--namespace", default="default")
     ffs.add_argument("--repair", action="store_true")
 
+    status = sub.add_parser(
+        "status", help="live campaign/fleet status: render the "
+                       "atomically-refreshed status.json a running "
+                       "campaign (--telemetry-dir) or master "
+                       "(--telemetry-dir exports) maintains")
+    status.add_argument("dir", type=Path,
+                        help="the telemetry/export dir holding "
+                             "status.json (a campaign's --telemetry-dir "
+                             "or a master's)")
+    status.add_argument("--json", action="store_true",
+                        help="print the raw status document")
+    status.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                        help="re-render every SECS seconds until ^C "
+                             "(0 = render once)")
+
     lint = sub.add_parser(
         "lint", help="graph-invariant static analysis of the hot-path "
                      "contracts (wtf_tpu/analysis; CPU-only, no chip)")
@@ -483,6 +514,26 @@ def _telemetry_for(args):
     including a failed backend build."""
     registry = Registry()
     events = open_event_log(getattr(args, "telemetry_dir", None))
+    trace_out = getattr(args, "trace_out", None)
+    collector = None
+    if trace_out is not None:
+        # --trace-out: every span becomes a Chrome-trace complete event
+        # via the registry's span collector, and every point event (the
+        # JSONL records minus the bulky heartbeat/run-start/run-end)
+        # becomes an instant mark on the same timeline
+        from wtf_tpu.telemetry import TapEventLog, TraceCollector
+
+        collector = TraceCollector()
+        registry.spans.collector = collector
+
+        def _instant(type_, fields):
+            if type_ in ("heartbeat", "run-start", "run-end"):
+                return
+            collector.instant(type_, {
+                k: v for k, v in fields.items()
+                if isinstance(v, (str, int, float, bool))})
+
+        events = TapEventLog(events, _instant)
     events.emit("run-start", subcommand=args.subcommand,
                 name=getattr(args, "name", None),
                 backend=getattr(args, "backend", None),
@@ -491,6 +542,13 @@ def _telemetry_for(args):
         yield registry, events
     finally:
         events.emit("run-end", metrics=registry.dump())
+        if collector is not None:
+            try:
+                n = collector.write(trace_out)
+                print(f"trace: {n} events -> {trace_out}")
+            except OSError as e:
+                logging.getLogger("wtf_tpu").warning(
+                    "trace write failed: %s", e)
         events.close()
 
 
@@ -677,7 +735,7 @@ def cmd_master(args) -> int:
                         coverage_path=coverage_path,
                         registry=registry, events=events,
                         reclaim_timeout=opts.reclaim_timeout,
-                        store=store)
+                        store=store, telemetry_dir=args.telemetry_dir)
         stats = server.run()
     print(server.stats.line(len(server.coverage), len(corpus), 0))
     if server.drained:
@@ -762,7 +820,9 @@ def cmd_campaign(args) -> int:
                         registry=registry, events=events,
                         checkpoint_dir=ckpt_dir,
                         checkpoint_every=opts.checkpoint_every,
-                        store=store, megachunk=opts.megachunk)
+                        store=store, megachunk=opts.megachunk,
+                        xprof_dir=args.xprof_dir,
+                        xprof_batches=args.xprof_batches)
         if opts.resume:
             from wtf_tpu.resume import load_campaign, restore_campaign
 
@@ -1111,6 +1171,120 @@ def cmd_lint(args) -> int:
                          registry=registry, events=events)
 
 
+def _derived_status_rows(metrics: dict) -> List[str]:
+    """The operator-facing derived lines shared by campaign and fleet
+    status: each row appears only when its subsystem actually ran, so a
+    plain campaign renders just the heartbeat line."""
+    rows: List[str] = []
+
+    def val(name, default=0):
+        v = metrics.get(name, default)
+        return v if isinstance(v, (int, float)) else default
+
+    instr = val("device.instructions")
+    fused = val("device.fused_steps")
+    if fused and instr:
+        rows.append(f"fused occupancy: {fused / instr:.1%}")
+    windows = val("megachunk.windows")
+    if windows:
+        zh = val("devdec.zero_host_windows")
+        rows.append(f"zero-host windows: {zh}/{windows} "
+                    f"({zh / windows:.0%})")
+        prelaunched = val("megachunk.prelaunched")
+        if prelaunched:
+            rows.append(f"prelaunch: "
+                        f"{val('megachunk.prelaunch_hits')}/{prelaunched}"
+                        f" adopted, {val('megachunk.prelaunch_dropped')}"
+                        f" dropped")
+    phase = metrics.get("phase.seconds") or {}
+    if isinstance(phase, dict) and phase:
+        from wtf_tpu.telemetry.spans import DEVICE_SPAN_LEAVES
+
+        top = sum(s for p, s in phase.items() if "/" not in p)
+        dev = sum(s for p, s in phase.items()
+                  if "/" in p and p.split("/")[-1] in DEVICE_SPAN_LEAVES)
+        if top:
+            rows.append(f"host share: "
+                        f"{max(top - dev, 0.0) / top:.1%} of "
+                        f"accounted wall")
+    if val("supervise.dispatches"):
+        rows.append(f"supervisor: rung {val('supervise.rung')}, "
+                    f"{val('supervise.rebuilds')} rebuilds, "
+                    f"{val('supervise.quarantined_lanes')} lanes "
+                    f"quarantined")
+    delta = val("dist.cov_bytes_delta")
+    bitmap = val("dist.cov_bytes_bitmap")
+    if delta and bitmap:
+        rows.append(f"delta frames: {bitmap - delta} cov bytes saved "
+                    f"({bitmap / delta:.1f}x smaller)")
+    tenants = sorted({name.split(".")[1] for name in metrics
+                      if name.startswith("tenant.")
+                      and len(name.split(".")) >= 3})
+    for t in tenants:
+        rows.append(f"tenant {t}: "
+                    f"execs={metrics.get(f'tenant.{t}.testcases', 0) or 0}"
+                    f" newcov="
+                    f"{metrics.get(f'tenant.{t}.new_coverage', 0) or 0}"
+                    f" crashes="
+                    f"{metrics.get(f'tenant.{t}.crashes', 0) or 0}")
+    return rows
+
+
+def _render_status(doc: dict) -> None:
+    age = max(time.time() - float(doc.get("ts", 0) or 0), 0.0)
+    if doc.get("kind") == "fleet":
+        print(f"fleet: {doc.get('nodes', 0)} node(s), "
+              f"{doc.get('frames', 0)} telem frames "
+              f"({doc.get('duplicates_dropped', 0)} duplicates dropped), "
+              f"as of {age:.0f}s ago")
+        for row in doc.get("per_node", []):
+            print(f"  {row.get('node', '?')[:12]:<12} "
+                  f"seq={row.get('seq')}/e{row.get('epoch')} "
+                  f"execs={row.get('testcases')} "
+                  f"({row.get('execs_per_s')}/s) "
+                  f"crash={row.get('crashes')} "
+                  f"newcov={row.get('new_coverage')}")
+    else:
+        print(f"campaign: batch {doc.get('batches', 0)}, "
+              f"as of {age:.0f}s ago")
+        if doc.get("line"):
+            print(f"  {doc['line']}")
+    for row in _derived_status_rows(doc.get("metrics") or {}):
+        print(f"  {row}")
+
+
+def cmd_status(args) -> int:
+    """`wtf-tpu status <dir>`: render the status.json a running campaign
+    (FuzzLoop._write_status, every heartbeat) or fleet master
+    (FleetTelemetry.write_exports, every persistence interval) refreshes
+    atomically — readers always see a complete document."""
+    import json
+
+    path = args.dir / "status.json"
+    while True:
+        try:
+            doc = json.loads(path.read_text())
+        except FileNotFoundError:
+            print(f"status: no status.json under {args.dir} — is the "
+                  f"campaign/master running with --telemetry-dir?")
+            return 1
+        except ValueError:
+            doc = None  # mid-rotation torn read: keep the last render
+        if doc is not None:
+            if args.json:
+                print(json.dumps(doc))
+            else:
+                if args.watch:
+                    print("\x1b[2J\x1b[H", end="")
+                _render_status(doc)
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
 def cmd_snapshot(args) -> int:
     """Format conversion: the bdump-side tooling the reference leaves to
     external scripts.  npz <-> Windows crash dump both ways."""
@@ -1171,14 +1345,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "triage": cmd_triage,
         "fleet": cmd_fleet,
         "lint": cmd_lint,
+        "status": cmd_status,
     }[args.subcommand]
     return driver(args)
 
 
 def console_main() -> None:
     """setuptools console-script entry (`wtf-tpu ...`)."""
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `wtf-tpu status --json | head` closed the pipe: normal
+        # operator usage, not an error
+        sys.exit(0)
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    console_main()

@@ -209,6 +209,15 @@ class MasterLink:
         frame of them on mux links)."""
         return self.send(bytes((wire.TAG_COVDELTA,)) + body)
 
+    def send_telem(self, body: bytes) -> bool:
+        """Send one TAG_TELEM frame (wire.encode_telem body).  WTF3
+        links only — v1/v2 masters would read the tag byte as the start
+        of a result body.  Best-effort like every upstream send: a lost
+        snapshot is superseded by the next one (they are cumulative)."""
+        if self.cursor is None:
+            return False
+        return self.send(bytes((wire.TAG_TELEM,)) + body)
+
     def send(self, body: bytes) -> bool:
         """Best-effort result send.  On failure the socket drops and the
         result is abandoned (see class docstring); the next recv_work
@@ -226,20 +235,69 @@ class MasterLink:
 class _NodeTelemetry:
     """Shared node-side telemetry: the same `campaign.*` counters and
     heartbeat line shape as the fused loop/master (cov/corp omitted — a
-    node doesn't track them), wired identically for both node shapes."""
+    node doesn't track them), wired identically for both node shapes.
+
+    WTF3 nodes additionally ship a TAG_TELEM frame on the heartbeat
+    cadence: the node's CUMULATIVE Registry.snapshot() plus a digest of
+    recent node events, sequence-numbered so the master's aggregator
+    stays idempotent under reconnect replays.  Emission rides the
+    EXISTING heartbeat throttle — snapshot serialization never touches
+    the per-testcase (or per-batch dispatch) path, which the telemetry
+    lint family pins statically."""
 
     def _init_telemetry(self, backend, registry, events,
                         stats_every: float, print_stats: bool) -> None:
         self.registry, self.events = telemetry.resolve(
             backend, registry, events)
+        # recent-event digest ring: node-level events (retry/reconnect/
+        # crash/...) tap in here on their way to the JSONL sink and ride
+        # the next telem frame upstream
+        from collections import deque
+
+        from wtf_tpu.telemetry import TapEventLog
+
+        self._recent_events = deque(maxlen=64)
+        self.events = TapEventLog(self.events, self._tap_event)
         self.stats = CampaignStats(self.registry)
         self.stats_every = stats_every
         self.print_stats = print_stats
+        self._telem_seq = 0
+        self._telem_last = 0.0
+        self._telem_link: Optional[MasterLink] = None
+
+    def _tap_event(self, type_: str, fields: dict) -> None:
+        if type_ == "heartbeat":
+            return  # carried whole by the telem frame itself
+        digest = {"type": type_}
+        for key in ("name", "kind", "count", "attempts", "bucket"):
+            if key in fields:
+                digest[key] = fields[key]
+        self._recent_events.append(digest)
 
     def _heartbeat(self) -> None:
         self.stats.maybe_heartbeat(self.events, self.registry,
                                    every=self.stats_every,
                                    print_stats=self.print_stats)
+        # telem emission has its OWN throttle: a node with no local
+        # event log (maybe_heartbeat early-returns there) still reports
+        # to the master's fleet plane
+        now = time.time()
+        if now - self._telem_last >= self.stats_every:
+            self._telem_last = now
+            self._send_telem()
+
+    def _send_telem(self) -> None:
+        """One TAG_TELEM frame on the designated WTF3 link (no-op for
+        v1/v2 wire shapes — those masters predate the frame)."""
+        link = self._telem_link
+        if link is None or link.cursor is None:
+            return
+        self._telem_seq += 1
+        recent = list(self._recent_events)
+        if link.send_telem(wire.encode_telem(
+                self._telem_seq, self.registry.snapshot(), recent)):
+            self._recent_events.clear()
+            self.registry.counter("dist.telem_sent").inc()
 
 
 def run_testcase_and_restore(backend, target, data: bytes,
@@ -307,6 +365,7 @@ class Client(_NodeTelemetry):
                           rng=self.retry_rng, tagged=not self.wire_v1,
                           cursor=cursor)
         link.connect(retry_for=10.0)
+        self._telem_link = link
         try:
             while max_runs == 0 or self.runs < max_runs:
                 testcase = link.recv_work()
@@ -456,6 +515,9 @@ class BatchClient(_NodeTelemetry):
                 self.target.restore()
                 self.backend.restore()
                 self.rounds += 1
+                # ONE lane link carries the node's telem frames (the
+                # registry is node-wide; one identity owns its totals)
+                self._telem_link = links[0] if links else None
                 self._heartbeat()
         finally:
             for link in links:
@@ -471,6 +533,7 @@ class BatchClient(_NodeTelemetry):
                   if self.cov_delta else None)
         link = self._link(self.backend.n_lanes, cursor=cursor)
         link.connect(retry_for=10.0)
+        self._telem_link = link
         try:
             while max_rounds == 0 or self.rounds < max_rounds:
                 frame = link.recv_work()

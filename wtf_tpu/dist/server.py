@@ -103,6 +103,7 @@ class Server:
         drain_grace: float = 5.0,
         store=None,
         cursor_cap: int = 4096,
+        telemetry_dir: Optional[Path] = None,
     ):
         self.address = address
         self.mutator = mutator
@@ -176,6 +177,12 @@ class Server:
         # reclaimed to the pending deque (`dist.reclaimed`); 0 disables
         # the silence timeout (drop-detection reclaim is always on)
         self.reclaim_timeout = reclaim_timeout
+        # fleet observability: TAG_TELEM snapshots from WTF3 nodes merge
+        # here (wtf_tpu/fleet/telemetry); exports land next to the other
+        # interval persistence when a telemetry dir is configured
+        from wtf_tpu.fleet.telemetry import FleetTelemetry
+
+        self.fleet_telem = FleetTelemetry(export_dir=telemetry_dir)
         # SIGTERM drain: stop serving, give in-flight results this long
         # to land, persist, notify nodes, exit the reactor cleanly
         self.drain_grace = drain_grace
@@ -415,6 +422,7 @@ class Server:
                     self._last_persist = now
                     self._evict_cursors()
                     self._write_coverage()
+                    self.fleet_telem.write_exports()
         finally:
             restore_sigterm()
             for sock, conn in list(self._clients.items()):
@@ -432,6 +440,7 @@ class Server:
             self._listener.close()
             self._listener = None
             self._write_coverage(final=True)
+            self.fleet_telem.close()
         return self.stats
 
     def _install_sigterm(self):
@@ -606,6 +615,13 @@ class Server:
             if not conn.inflight:
                 self._set_writable(sock, True)  # greeted: open for work
             return
+        if conn.delta and body and body[0] == wire.TAG_TELEM:
+            # observability frame: no slot accounting, no writability
+            # change — it rides BETWEEN work exchanges.  Malformed telem
+            # is dropped without dropping the node (it carries no
+            # campaign state, unlike a malformed result frame).
+            self._handle_telem(conn, body[1:])
+            return
         try:
             # decode EVERYTHING before accounting ANYTHING: a malformed
             # tail in a mux batch must not leave already-counted results
@@ -633,6 +649,25 @@ class Server:
             self._account_result(*item)
         conn.inflight = []
         self._set_writable(sock, True)
+
+    def _handle_telem(self, conn: _Conn, payload: bytes) -> None:
+        """One TAG_TELEM frame: merge the node's cumulative snapshot into
+        the fleet aggregate, keyed by its WTF3 client identity.  The seq
+        check inside the aggregator makes re-sent frames (reconnect
+        replays, reclaim races) free of double-counting."""
+        try:
+            seq, snapshot, events = wire.decode_telem(payload)
+        except (ValueError, KeyError, struct.error,
+                UnicodeDecodeError) as e:
+            self.registry.counter("fleet.telem_errors").inc()
+            self.events.emit("error", kind="malformed-telem",
+                             detail=repr(e))
+            return
+        applied = self.fleet_telem.apply(conn.client_id, seq, snapshot,
+                                         events)
+        self.registry.counter("fleet.telem_frames").inc()
+        if not applied:
+            self.registry.counter("fleet.telem_duplicates").inc()
 
     def _cursor_for(self, conn: _Conn):
         from wtf_tpu.fleet.delta import ServerCursor

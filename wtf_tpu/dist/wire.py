@@ -179,10 +179,19 @@ HELLO3_MAGIC = b"WTF3"   # v3: v2 + streaming coverage deltas (fleet tier)
 #                 bits only, as sparse word-index+mask pairs over the
 #                 client's own bit space, with bit->address table
 #                 registrations riding alongside
+#   TAG_TELEM     node->master (tagged/delta connections): a periodic
+#                 telemetry snapshot — the node's CUMULATIVE registry
+#                 state plus a digest of recent events, sequence-numbered
+#                 per connection epoch.  Pure observability: carries no
+#                 campaign state, so the master may drop it on decode
+#                 error without touching slot accounting, and a re-sent
+#                 frame can never double-count (the aggregator keeps the
+#                 latest snapshot per client identity).
 TAG_WORK = 0
 TAG_BYE = 1
 TAG_CURSOR = 2
 TAG_COVDELTA = 3
+TAG_TELEM = 4
 
 CLIENT_ID_LEN = 16
 
@@ -451,3 +460,35 @@ def decode_result_delta(body: bytes):
         result = Crash(name or None)
     delta = DeltaFrame(bool(flags & FLAG_FULL), table_base, addrs, pairs)
     return testcase, delta, result, bucket
+
+
+# ---------------------------------------------------------------------------
+# telemetry snapshot body (TAG_TELEM upstream frames)
+# ---------------------------------------------------------------------------
+# Observability piggybacks on the work connection instead of opening a
+# second control plane: once per node heartbeat the client ships its
+# CUMULATIVE Registry.snapshot() plus a short digest of recent events.
+# The payload is JSON — telemetry names are an open set (tenant
+# namespaces, backend counters) and this frame is heartbeat-rate, not
+# per-testcase, so schema flexibility beats struct packing here.  The
+# u32 seq is per connection epoch and strictly increasing; the master's
+# aggregator drops seq <= last-applied for the same client identity, so
+# a frame replayed across a reconnect can never double-count.
+
+def encode_telem(seq: int, snapshot: dict, events=()) -> bytes:
+    """Body of a TAG_TELEM frame (tag byte NOT included, matching
+    encode_result_delta): u32 seq | json({"snapshot", "events"})."""
+    import json
+
+    payload = json.dumps({"snapshot": snapshot, "events": list(events)},
+                         default=str).encode()
+    return struct.pack("<I", seq) + payload
+
+
+def decode_telem(body: bytes) -> Tuple[int, dict, list]:
+    """-> (seq, snapshot, events) of a TAG_TELEM frame payload."""
+    import json
+
+    (seq,) = struct.unpack_from("<I", body, 0)
+    payload = json.loads(body[4:].decode())
+    return seq, payload.get("snapshot", {}), payload.get("events", [])

@@ -78,11 +78,13 @@ class SimClient:
 
     def __init__(self, address: str, model: CoverageModel, mode: str,
                  seed: int, registry, max_retry_secs: float = 30.0,
-                 faults: Optional[Dict[int, str]] = None):
+                 faults: Optional[Dict[int, str]] = None,
+                 telem_every: int = 0, telem_dup_every: int = 0):
         assert mode in ("delta", "v2", "v1")
         self.model = model
         self.mode = mode
         self.faults = dict(faults or {})
+        self.registry = registry
         cursor = (AddressDeltaCursor(registry=registry)
                   if mode == "delta" else None)
         self.link = MasterLink(address, 1, max_retry_secs,
@@ -93,6 +95,32 @@ class SimClient:
         self.runs = 0
         self.drops = 0
         self.resets = 0
+        # TAG_TELEM emission (obs_smoke): every `telem_every` runs send
+        # the client registry's cumulative snapshot; every
+        # `telem_dup_every`-th frame is sent TWICE verbatim — the
+        # scripted duplicate the master must drop by sequence number
+        self.telem_every = telem_every
+        self.telem_dup_every = telem_dup_every
+        self._telem_seq = 0
+        self.telem_dups_sent = 0
+        self.last_telem: Optional[dict] = None
+
+    def send_telem(self) -> None:
+        """One cumulative snapshot frame on the live work connection
+        (plus a scripted verbatim duplicate when dialed)."""
+        if self.link.cursor is None:
+            return
+        self._telem_seq += 1
+        snapshot = self.registry.snapshot()
+        body = wire.encode_telem(self._telem_seq, snapshot)
+        if not self.link.send_telem(body):
+            self._telem_seq -= 1
+            return
+        self.last_telem = snapshot
+        if (self.telem_dup_every
+                and self._telem_seq % self.telem_dup_every == 0):
+            if self.link.send_telem(body):
+                self.telem_dups_sent += 1
 
     def connect(self) -> None:
         self.link.connect(retry_for=30.0)
@@ -103,6 +131,12 @@ class SimClient:
         tc = self.link.recv_work()
         if tc is None:
             return False
+        self.registry.counter("campaign.testcases").inc()
+        if self.telem_every and (self.runs + 1) % self.telem_every == 0:
+            # BEFORE the result send: the lock-step master always reads
+            # up to the next result frame, so a telem frame that
+            # precedes one is never stranded behind the final BYE
+            self.send_telem()
         coverage = self.model.cover(tc)
         new = coverage - self.local
         self.local |= coverage

@@ -147,6 +147,9 @@ class FuzzLoop:
         checkpoint_every: int = 0,
         store=None,
         megachunk: int = 0,
+        xprof_dir: Optional[Path] = None,
+        xprof_batches: int = 4,
+        xprof_skip: int = 2,
     ):
         self.backend = backend
         self.target = target
@@ -230,6 +233,17 @@ class FuzzLoop:
         # wrapper in run_one_batch; the ladder attaches lazily at the
         # first batch (the backend may not be initialized yet here)
         self.supervisor = getattr(backend, "supervisor", None)
+        # --xprof-dir: one jax.profiler.trace window over N STEADY-STATE
+        # batches (the first `xprof_skip` are compile/warmup noise — the
+        # profile must show the regime PERF.md's numbers describe, not
+        # tracing).  One window per campaign; device-level truth for
+        # what the span timeline (--trace-out) claims from the host side
+        self.xprof_dir = Path(xprof_dir) if xprof_dir else None
+        self.xprof_batches = int(xprof_batches)
+        self.xprof_skip = int(xprof_skip)
+        self._xprof_active = False
+        self._xprof_done = False
+        self._xprof_start_batch = 0
         if self.checkpoint_every and not hasattr(backend, "coverage_state"):
             # fail at construction, not at the first cadence hit deep
             # into a campaign (the checkpoint needs the batched backend's
@@ -416,8 +430,15 @@ class FuzzLoop:
             lanes = self.batch_size
             window = min(window, max(1, -(-int(remaining) // lanes)))
         with spans.span("execute"):
-            batches = self.backend.run_megachunk(
-                self.mutator, self.target, self.megachunk, window)
+            # the mark draws the WHOLE one-dispatch window in the trace
+            # timeline (--trace-out) — its extent against the device
+            # leaves inside run_megachunk is the visual form of the
+            # zero-host claim.  A trace-only mark, not a nested span:
+            # the device wait must keep recording under the flat
+            # execute/device path the host-share accounting reads.
+            with spans.trace_mark("megachunk-window"):
+                batches = self.backend.run_megachunk(
+                    self.mutator, self.target, self.megachunk, window)
         crashes = 0
         timeouts_before = self.stats.timeouts
         with spans.span("harvest"):
@@ -481,17 +502,133 @@ class FuzzLoop:
         self.events.emit("crash", name=name, size=len(data), new=new,
                          bucket=bucket)
 
+    def _peek(self, name: str):
+        """Counter value WITHOUT registering it — the heartbeat must not
+        seed zero-valued metrics into dumps of campaigns that never
+        touched the subsystem."""
+        metric = self.registry._metrics.get(name)
+        return metric.value if metric is not None else 0
+
+    def steady_state_fields(self) -> dict:
+        """The PR-14 zero-host steady-state numbers, as heartbeat fields
+        — live visibility for the claim telemetry_report proves
+        post-mortem.  Empty for campaigns that never ran a window."""
+        fields = {}
+        windows = self._peek("megachunk.windows")
+        if windows:
+            fields["zero_host_window_rate"] = round(
+                self._peek("devdec.zero_host_windows") / windows, 3)
+        prelaunched = self._peek("megachunk.prelaunched")
+        if prelaunched:
+            fields["prelaunch_hits"] = self._peek(
+                "megachunk.prelaunch_hits")
+            fields["prelaunch_dropped"] = self._peek(
+                "megachunk.prelaunch_dropped")
+        crosschecks = self._peek("devdec.crosscheck_mismatches")
+        if self._peek("devdec.published") or crosschecks:
+            fields["devdec_crosscheck_mismatches"] = crosschecks
+        return fields
+
+    def _steady_line_suffix(self, fields: dict) -> str:
+        """The same numbers on the human line — shown only when the
+        campaign runs windows, so plain-campaign line format is
+        untouched."""
+        out = ""
+        if "zero_host_window_rate" in fields:
+            out += f" zh: {fields['zero_host_window_rate']:.0%}"
+        if "prelaunch_hits" in fields:
+            launched = self._peek("megachunk.prelaunched")
+            out += f" pre: {fields['prelaunch_hits']}/{launched}"
+            if fields.get("prelaunch_dropped"):
+                out += f"(-{fields['prelaunch_dropped']})"
+        return out
+
     def _heartbeat(self, print_stats: bool) -> None:
         """stats_every cadence: the stable human line + one JSONL
         heartbeat carrying the full registry dump (per-phase span totals
-        included)."""
+        included) + an atomic status.json refresh next to the event log
+        (what `wtf-tpu status` tails on a live local campaign)."""
         fields = (self.supervisor.heartbeat_fields()
                   if self.supervisor is not None
                   and self.supervisor.enabled else {})
-        self.stats.maybe_heartbeat(
+        steady = self.steady_state_fields()
+        fields.update(steady)
+        line = self.stats.maybe_heartbeat(
             self.events, self.registry,
-            lambda: self.stats.line(len(self.corpus), self._coverage()),
+            lambda: self.stats.line(len(self.corpus), self._coverage())
+            + self._steady_line_suffix(steady),
             every=self.stats_every, print_stats=print_stats, **fields)
+        if line is not None:
+            self._write_status(line)
+
+    def _write_status(self, line: str) -> None:
+        """status.json beside events.jsonl, atomically replaced every
+        heartbeat — readers (wtf-tpu status --watch) always see either
+        the previous complete document or this one, never a torn
+        middle.  Best-effort like every telemetry side channel."""
+        path = getattr(self.events, "path", None)
+        if path is None:
+            return
+        import json
+
+        from wtf_tpu.utils.atomicio import atomic_write_text
+
+        doc = {"kind": "campaign", "ts": time.time(), "line": line,
+               "batches": self.batches_done,
+               "metrics": self.registry.dump()}
+        try:
+            atomic_write_text(Path(path).parent / "status.json",
+                              json.dumps(doc, default=str), fsync=False)
+        except OSError:
+            pass
+
+    def _maybe_xprof(self) -> None:
+        """Arm/disarm the one device-profiler window at batch
+        boundaries.  Best-effort: a platform without profiler support
+        logs once and the campaign proceeds unprofiled."""
+        if self.xprof_dir is None or self._xprof_done:
+            return
+        if not self._xprof_active:
+            if self.batches_done < self.xprof_skip:
+                return
+            try:
+                import jax
+
+                jax.profiler.start_trace(str(self.xprof_dir))
+            except Exception as e:  # noqa: BLE001 - profiler is optional
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "xprof trace unavailable: %s", e)
+                self.events.emit("error", kind="xprof-start",
+                                 detail=str(e))
+                self._xprof_done = True
+                return
+            self._xprof_active = True
+            self._xprof_start_batch = self.batches_done
+            self.events.emit("xprof-start", batch=self.batches_done,
+                             dir=str(self.xprof_dir))
+            return
+        if (self.batches_done
+                >= self._xprof_start_batch + self.xprof_batches):
+            self._stop_xprof()
+
+    def _stop_xprof(self) -> None:
+        if not self._xprof_active:
+            return
+        self._xprof_active = False
+        self._xprof_done = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            self.events.emit("error", kind="xprof-stop", detail=str(e))
+            return
+        self.events.emit("xprof-stop", batch=self.batches_done,
+                         batches=self.batches_done
+                         - self._xprof_start_batch,
+                         dir=str(self.xprof_dir))
 
     def minset(self, outputs_dir, print_stats: bool = False) -> Corpus:
         """`--runs=0` mode: replay the seed corpus exactly once — no
@@ -531,15 +668,19 @@ class FuzzLoop:
         --runs=0 to `minset` instead, matching the reference)."""
         self.reshard_to = None
         self._runs_budget = runs
-        while runs == 0 or self.stats.testcases < runs:
-            found = self.run_one_batch()
-            self.batches_done += 1
-            self._maybe_checkpoint()
-            if self._maybe_reshard():
-                break
-            self._heartbeat(print_stats)
-            if stop_on_crash and found:
-                break
+        try:
+            while runs == 0 or self.stats.testcases < runs:
+                self._maybe_xprof()
+                found = self.run_one_batch()
+                self.batches_done += 1
+                self._maybe_checkpoint()
+                if self._maybe_reshard():
+                    break
+                self._heartbeat(print_stats)
+                if stop_on_crash and found:
+                    break
+        finally:
+            self._stop_xprof()
         return self.stats
 
     def _maybe_reshard(self) -> bool:

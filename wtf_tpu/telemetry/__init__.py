@@ -16,13 +16,13 @@ into a Registry by the backend once per burst).
 """
 
 from wtf_tpu.telemetry.events import (  # noqa: F401
-    NULL, EventLog, NullEventLog, open_event_log, read_events,
+    NULL, EventLog, NullEventLog, TapEventLog, open_event_log, read_events,
 )
 from wtf_tpu.telemetry.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, LabeledView, Registry, StatsDict,
-    get_registry,
+    get_registry, merge_snapshots,
 )
-from wtf_tpu.telemetry.spans import Spans  # noqa: F401
+from wtf_tpu.telemetry.spans import Spans, TraceCollector  # noqa: F401
 
 
 def resolve(backend=None, registry=None, events=None):

@@ -91,18 +91,29 @@ class EventLog(NullEventLog):
     heartbeat-scale (not per-testcase), and a crashed run must not lose
     its tail."""
 
-    def __init__(self, path, clock=time.time):
+    def __init__(self, path, clock=time.time,
+                 max_bytes: Optional[int] = None):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = open(self.path, "a", encoding="utf-8")
         self._clock = clock
         self._seq = 0
         self._broken = False
+        # size-based rotation (events.jsonl -> events.jsonl.1): a
+        # 1000-client soak or multi-day campaign must not grow the sink
+        # unboundedly.  None (the default) keeps the historical
+        # append-forever behavior; WTF_TPU_EVENTS_MAX_BYTES sets a
+        # process-wide default cap.
+        if max_bytes is None:
+            env = os.environ.get("WTF_TPU_EVENTS_MAX_BYTES")
+            max_bytes = int(env) if env else None
+        self.max_bytes = max_bytes
 
     @classmethod
-    def for_dir(cls, directory) -> "EventLog":
+    def for_dir(cls, directory, max_bytes: Optional[int] = None
+                ) -> "EventLog":
         """The --telemetry-dir convention: events.jsonl inside it."""
-        return cls(Path(directory) / "events.jsonl")
+        return cls(Path(directory) / "events.jsonl", max_bytes=max_bytes)
 
     def emit(self, type: str, **fields) -> None:  # noqa: A002
         # Telemetry is an observability side-channel: a full disk or a
@@ -117,8 +128,22 @@ class EventLog(NullEventLog):
         try:
             self._fh.write(json.dumps(record, default=str) + "\n")
             self._fh.flush()
+            if self.max_bytes is not None and \
+                    self._fh.tell() >= self.max_bytes:
+                self._rotate()
         except OSError as e:
             self._disable(e)
+
+    def _rotate(self) -> None:
+        """events.jsonl -> events.jsonl.1 (replacing any prior .1) and
+        reopen fresh.  One generation of history is the deliberate cap:
+        the stream's job is the recent past; the registry carries the
+        cumulative totals.  Torn tails survive rotation because readers
+        (read_events) skip unparseable lines in EVERY generation."""
+        self._fh.close()
+        rotated = self.path.with_name(self.path.name + ".1")
+        os.replace(self.path, rotated)
+        self._fh = open(self.path, "a", encoding="utf-8")
 
     def heartbeat(self, registry=None, line: Optional[str] = None,
                   **fields) -> None:
@@ -162,15 +187,60 @@ def open_event_log(telemetry_dir) -> NullEventLog:
     return EventLog.for_dir(telemetry_dir)
 
 
-def read_events(path):
+class TapEventLog(NullEventLog):
+    """Wraps a sink and mirrors every record to a tap callable
+    `tap(type, fields)` — how --trace-out turns point events (compile,
+    checkpoint, recovery, prelaunch drops) into trace instants without
+    every emitter learning about tracing.  Tap failures are swallowed:
+    observability must never abort the campaign."""
+
+    def __init__(self, inner, tap):
+        self._inner = inner
+        self._tap = tap
+
+    @property
+    def path(self):  # type: ignore[override]
+        return self._inner.path
+
+    def emit(self, type: str, **fields) -> None:  # noqa: A002
+        try:
+            self._tap(type, fields)
+        except Exception:
+            pass
+        self._inner.emit(type, **fields)
+
+    def heartbeat(self, registry=None, line: Optional[str] = None,
+                  **fields) -> None:
+        try:
+            # the tap sees the light fields, not the full metrics dump —
+            # serializing the registry belongs to the sink, not the trace
+            self._tap("heartbeat", dict(fields, line=line))
+        except Exception:
+            pass
+        self._inner.heartbeat(registry=registry, line=line, **fields)
+
+    def flush(self) -> None:
+        self._inner.flush()
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+def read_events(path, rotated: bool = False):
     """Yield records from an events.jsonl (skipping any torn final line —
-    a killed run may die mid-write)."""
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                yield json.loads(line)
-            except json.JSONDecodeError:
-                continue
+    a killed run may die mid-write; rotation can freeze a torn tail into
+    the .1 generation, so EVERY generation gets the same tolerance).
+    With rotated=True, records from `<path>.1` come first."""
+    paths = [Path(str(path) + ".1"), Path(path)] if rotated else [Path(path)]
+    for p in paths:
+        if not p.exists():
+            continue
+        with open(p, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue

@@ -151,6 +151,54 @@ class Registry:
         {count,sum,min,max} for histograms."""
         return {name: m.dump() for name, m in sorted(self._metrics.items())}
 
+    # -- fleet snapshots (dist TAG_TELEM / fleet/telemetry.py) -------------
+    def snapshot(self) -> Dict[str, object]:
+        """Wire-portable FULL state — unlike counters_state this includes
+        histograms and every namespace, because the fleet aggregator's
+        job is to reproduce the node's registry exactly:
+          {name: {"kind": "c"|"g", "value": n}           unlabeled
+                 {"kind": "c"|"g", "labels": {l: n}}     labeled
+                 {"kind": "h", "count","sum","min","max"} histogram}
+        Snapshots are CUMULATIVE (a node resends its running totals), so
+        the merge keeps only the latest per node and re-sends/reconnects
+        can never double-count."""
+        out: Dict[str, object] = {}
+        for name, metric in self._metrics.items():
+            if isinstance(metric, Histogram):
+                out[name] = {"kind": "h", "count": metric.count,
+                             "sum": metric.sum, "min": metric.min,
+                             "max": metric.max}
+                continue
+            kind = "g" if isinstance(metric, Gauge) else "c"
+            if metric._children is not None:
+                out[name] = {"kind": kind, "labels": {
+                    label: c.value for label, c in metric._children.items()}}
+            else:
+                out[name] = {"kind": kind, "value": metric.value}
+        return out
+
+    def restore_snapshot(self, state: Dict[str, object]) -> None:
+        """Install a snapshot() (or merge_snapshots()) dict into this
+        registry — the fleet aggregator renders its merged state through
+        a real Registry so dump()/report code works unchanged."""
+        for name, entry in state.items():
+            kind = entry.get("kind")
+            if kind == "h":
+                hist = self.histogram(name)
+                hist.count = entry.get("count", 0)
+                hist.sum = entry.get("sum", 0.0)
+                hist.min = entry.get("min")
+                hist.max = entry.get("max")
+                continue
+            metric = self.gauge(name) if kind == "g" else self.counter(name)
+            if "labels" in entry:
+                if metric._children is None:
+                    metric._children = {}  # declared labeled: dump as {}
+                for label, v in entry["labels"].items():
+                    metric.labels(label).set(v)
+            else:
+                metric.set(entry.get("value", 0))
+
     # -- checkpoint/resume (wtf_tpu/resume) --------------------------------
     def counters_state(self, prefixes) -> Dict[str, object]:
         """Counters/gauges under `prefixes` as {name: {kind, value}} —
@@ -179,6 +227,43 @@ class Registry:
                     metric.labels(label).set(v)
             else:
                 metric.set(value)
+
+
+def merge_snapshots(snapshots: Iterable[Dict[str, object]]
+                    ) -> Dict[str, object]:
+    """Sum N Registry.snapshot() dicts into one fleet-wide snapshot:
+    counters and gauges add (per label for labeled ones), histograms
+    combine (count/sum add, min/max extremize).  Kind conflicts take the
+    first writer — a fleet of same-version nodes never has any.  The
+    result is itself snapshot-shaped, so it round-trips through
+    Registry.restore_snapshot for rendering."""
+    merged: Dict[str, dict] = {}
+    for snap in snapshots:
+        for name, entry in snap.items():
+            kind = entry.get("kind")
+            cur = merged.get(name)
+            if cur is None:
+                cur = ({"kind": "h", "count": 0, "sum": 0.0,
+                        "min": None, "max": None} if kind == "h"
+                       else {"kind": kind}
+                       | ({"labels": {}} if "labels" in entry
+                          else {"value": 0}))
+                merged[name] = cur
+            if kind == "h":
+                cur["count"] += entry.get("count", 0)
+                cur["sum"] += entry.get("sum", 0.0)
+                for field, pick in (("min", min), ("max", max)):
+                    v = entry.get(field)
+                    if v is not None:
+                        cur[field] = (v if cur[field] is None
+                                      else pick(cur[field], v))
+            elif "labels" in entry:
+                labels = cur.setdefault("labels", {})
+                for label, v in entry["labels"].items():
+                    labels[label] = labels.get(label, 0) + v
+            else:
+                cur["value"] = cur.get("value", 0) + entry.get("value", 0)
+    return {name: merged[name] for name in sorted(merged)}
 
 
 _GLOBAL: Optional[Registry] = None
