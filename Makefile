@@ -8,10 +8,12 @@ PY ?= python
 
 .PHONY: verify test lint lint-rebaseline slow mesh-smoke chaos-smoke \
 	triage-smoke tenancy-smoke fleet-smoke fused-smoke \
-	device-chaos-smoke decode-smoke obs-smoke bench-guard
+	fused-mega-smoke device-chaos-smoke decode-smoke obs-smoke \
+	bench-guard
 
 verify: test lint chaos-smoke triage-smoke tenancy-smoke fleet-smoke \
-	fused-smoke device-chaos-smoke decode-smoke obs-smoke bench-guard
+	fused-smoke fused-mega-smoke device-chaos-smoke decode-smoke \
+	obs-smoke bench-guard
 
 # tier-1 (the ROADMAP.md command without the driver's log plumbing)
 test:
@@ -71,6 +73,14 @@ fleet-smoke:
 # window campaign bit-identical to the batch-at-a-time device loop
 fused-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.fused_smoke
+
+# fused-megachunk smoke (wtf_tpu/testing/fused_mega_smoke): the Pallas
+# kernel as the window's step engine must be bit-identical to the
+# XLA-ladder window at equal seeds, keep >=0.95 in-window occupancy,
+# and pass the donation lint (every donated/overlay leaf aliased in the
+# compiled window; jaxpr census on the megachunk_window_fused pin)
+fused-mega-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.testing.fused_mega_smoke
 
 # deterministic fault-tolerance soak (wtf_tpu/testing/chaos_smoke):
 # seeded fault schedule over the real socket + checkpoint seams —

@@ -222,6 +222,82 @@ def measure_megachunk(n_lanes=None, limit=100_000, seconds=10.0,
     return cols
 
 
+def measure_fused_mega(n_lanes=8, limit=20_000, window=3, batches=32,
+                       seed=0x5EED):
+    """Fused-window vs ladder-window A/B (the PR-19 tentpole): the same
+    equal-seed devmangle demo_tlv campaign through megachunk windows
+    whose quiesce body is the XLA ladder vs the Pallas fused kernel +
+    bounded-resume leg.  Reports the WINDOW KERNEL COUNT each way — the
+    ladder pays one full step-graph sweep (budgets.json `xla_step` total
+    kernels) per in-window round, the fused body pays ONE pallas dispatch
+    per round plus a short resume sweep — the donated bytes that stop
+    copying through the kernel each dispatch, and the bit-identity
+    verdict (coverage/edge bytes, corpus digests, crash buckets).  The
+    kernel-count collapse is deterministic (counter-derived at equal
+    seeds), so bench_guard treats it as an exact ratchet, not a noisy
+    wall-clock number."""
+    import jax
+
+    from wtf_tpu.analysis.rules import BUDGET_ENTRY, load_budgets
+    from wtf_tpu.analysis.trace import build_tlv_campaign
+    from wtf_tpu.interp.pstep import fused_available
+    from wtf_tpu.utils.hashing import hex_digest
+
+    report = {"config": "fused-mega", "n_lanes": n_lanes, "limit": limit,
+              "window": window, "batches": batches,
+              "platform": jax.devices()[0].platform}
+    if not fused_available():
+        report["skipped"] = "this jax build cannot run pallas kernels"
+        print(json.dumps(report), flush=True)
+        return report
+    # kernels per XLA ladder sweep: the checked-in step-graph pin
+    per_sweep = int(load_budgets()[BUDGET_ENTRY]["total"])
+    cols, fps = {}, {}
+    for mode in ("ladder", "fused"):
+        loop = build_tlv_campaign(
+            n_lanes=n_lanes, mutator="devmangle", limit=limit,
+            chunk_steps=128, overlay_slots=16, megachunk=window,
+            seed=seed, fused_step="on" if mode == "fused" else "off")
+        t0 = time.time()
+        loop.fuzz(n_lanes * batches)
+        dt = time.time() - t0
+        reg = loop.registry
+        sweeps = int(reg.counter("device.fused_window_xla_steps").value)
+        rounds = int(reg.counter("device.fused_window_rounds").value)
+        col = {
+            "wall_s": round(dt, 2),
+            "execs_per_s": round(loop.stats.testcases / dt, 2),
+            "windows": int(reg.counter("megachunk.windows").value),
+            "xla_sweeps": sweeps,
+            "pallas_dispatches": rounds,
+            "window_kernels": rounds + sweeps * per_sweep,
+        }
+        if mode == "fused":
+            saved = int(
+                reg.counter("device.fused_window_bytes_saved").value)
+            col["bytes_saved"] = saved
+            col["bytes_saved_per_dispatch"] = saved // max(rounds, 1)
+        cols[mode] = col
+        cov, edge = loop.backend.coverage_state()
+        fps[mode] = {
+            "cov": hex_digest(cov.tobytes()),
+            "edge": hex_digest(edge.tobytes()),
+            "cov_bits": loop._coverage(),
+            "corpus": [hex_digest(d) for d in loop.corpus],
+            "buckets": sorted(loop.crash_buckets),
+            "testcases": loop.stats.testcases,
+        }
+    report["ladder"] = cols["ladder"]
+    report["fused"] = cols["fused"]
+    report["kernels_per_sweep"] = per_sweep
+    report["kernel_reduction"] = round(
+        cols["ladder"]["window_kernels"] /
+        max(cols["fused"]["window_kernels"], 1), 2)
+    report["bit_identical"] = fps["ladder"] == fps["fused"]
+    print(json.dumps(report), flush=True)
+    return report
+
+
 def measure_decode(n_lanes=None, limit=100_000, seconds=10.0, window=16):
     """Device-decode A/B (the zero-host-steady-state tentpole): the
     same devmangle megachunk campaign host-serviced vs with
@@ -506,8 +582,9 @@ if __name__ == "__main__":
     faulthandler.dump_traceback_later(
         int(__import__("os").environ.get("ABLATE_WATCHDOG", "240")), exit=True)
     names = sys.argv[1:] or list(CONFIGS) + ["deep", "fused", "devmut",
-                                             "megachunk", "decode",
-                                             "lanes", "tenants", "fleet"]
+                                             "megachunk", "fused-mega",
+                                             "decode", "lanes", "tenants",
+                                             "fleet"]
     for n in names:
         if n == "deep":
             measure_deep()
@@ -517,6 +594,8 @@ if __name__ == "__main__":
             measure_devmut()
         elif n == "megachunk":
             measure_megachunk()
+        elif n == "fused-mega":
+            measure_fused_mega()
         elif n == "decode":
             measure_decode()
         elif n == "lanes":

@@ -320,6 +320,69 @@ def test_megachunk_hang_degrades_and_repromotes(ref_state):
     assert reg.counter("supervise.promotions").value >= 1
 
 
+def test_fused_window_hang_recovery_replays_bit_identical(ref_state):
+    """DEVICE_HANG mid-FUSED-window (the PR-19 window body): the
+    watchdog abandons the in-flight fused window — whose machine/overlay
+    planes are donated into the dispatch on real hardware — the rebuild
+    reconstructs them from live host-side state, and the replayed
+    campaign is bit-identical to the fault-free reference.  Index 17 is
+    a steady-state fused window in the supervised dispatch schedule
+    (0 = cold window, 1-16 = cold-decode fused servicing)."""
+    plan = FaultPlan([], device_faults={17: DEVICE_HANG})
+    loop = build_tlv_campaign(megachunk=2, fused_step="on",
+                              supervise=True, dispatch_timeout=30.0,
+                              promote_after=1, **BUILD)
+    with chaos_device(plan):
+        loop.fuzz(RUNS)
+    assert _state_of(loop) == ref_state
+    assert [f[:2] for f in plan.fired] == [("device-hang", "megachunk")]
+    reg = loop.backend.supervisor.registry
+    assert reg.counter("supervise.watchdog_fires").value == 1
+    assert reg.counter("supervise.rebuilds").value >= 1
+    # the fault really interrupted the fused body, not a ladder window
+    assert loop.registry.counter("device.fused_window_rounds").value > 0
+
+
+def test_fused_window_error_recovery_replays_bit_identical(ref_state):
+    """DEVICE_ERROR on the COLD fused window (dispatch 0): the very
+    first window's donated operands are rebuilt from the pristine host
+    snapshot and the campaign replays bit-identically."""
+    plan = FaultPlan([], device_faults={0: DEVICE_ERROR})
+    loop = build_tlv_campaign(megachunk=2, fused_step="on",
+                              supervise=True, dispatch_timeout=30.0,
+                              promote_after=1, **BUILD)
+    with chaos_device(plan):
+        loop.fuzz(RUNS)
+    assert _state_of(loop) == ref_state
+    assert [f[:2] for f in plan.fired] == [("device-error", "megachunk")]
+    reg = loop.backend.supervisor.registry
+    assert reg.counter("supervise.rebuilds").value >= 1
+    assert loop.registry.counter("device.fused_window_rounds").value > 0
+
+
+def test_no_fused_rung_disables_fused_window_body(ref_state):
+    """The no-fused rung's apply() clears runner.fused_enabled; the
+    megachunk WINDOW BODY must follow at the next dispatch (the flag is
+    read at call time and the compiled-window cache keys on it): pallas
+    dispatches stop, the XLA-ladder windows take over, and the campaign
+    stays bit-identical across the mid-campaign body swap."""
+    loop = build_tlv_campaign(megachunk=2, fused_step="on", **BUILD)
+    ladder = DegradationLadder(loop)
+    loop.fuzz(RUNS // 2)
+    reg = loop.registry
+    rounds_mid = reg.counter("device.fused_window_rounds").value
+    sweeps_mid = reg.counter("device.fused_window_xla_steps").value
+    assert rounds_mid > 0, "fused window body never ran"
+    while ladder.rung_name != "no-fused":
+        assert ladder.on_failure()
+    ladder.apply(loop)
+    assert loop.backend.runner.fused_enabled is False
+    loop.fuzz(RUNS)
+    assert reg.counter("device.fused_window_rounds").value == rounds_mid
+    assert reg.counter("device.fused_window_xla_steps").value > sweeps_mid
+    assert _state_of(loop) == ref_state
+
+
 @pytest.mark.slow
 def test_megachunk_hang_parity_at_every_dispatch_index(ref_state):
     """The window->legacy->window transition soak: a hang at EVERY index

@@ -51,8 +51,12 @@ HIGHER_BETTER = {
     "fused.occupancy",
     "megachunk.execs_per_s",
     "devmut.device_execs_per_s",
+    "fused_mega.kernel_reduction",
 }
-EXACT = {"budget.xla_step_total"}
+# counter-derived at equal seeds (fused_mega) or census pins: any
+# increase is a real step-graph/dispatch regression, no noise excuse
+EXACT = {"budget.xla_step_total", "budget.mega_window_total",
+         "fused_mega.window_kernels"}
 
 _MICRO_KEYS = ("branchy_instr_per_s", "chunk512_wall_s",
                "chunk_dispatch_floor_s")
@@ -96,9 +100,19 @@ def extract(doc: dict) -> dict:
     value = _num(devmut.get("execs_per_s"))
     if value is not None:
         out["devmut.device_execs_per_s"] = value
-    value = _num((doc.get("kernel_budget") or {}).get("xla_step_total"))
+    fm = doc.get("fused_mega") or {}
+    value = _num((fm.get("fused") or {}).get("window_kernels"))
     if value is not None:
-        out["budget.xla_step_total"] = value
+        out["fused_mega.window_kernels"] = value
+    value = _num(fm.get("kernel_reduction"))
+    if value is not None:
+        out["fused_mega.kernel_reduction"] = value
+    budget = doc.get("kernel_budget") or {}
+    for src, dst in (("xla_step_total", "budget.xla_step_total"),
+                     ("mega_window_total", "budget.mega_window_total")):
+        value = _num(budget.get(src))
+        if value is not None:
+            out[dst] = value
     return out
 
 
@@ -175,6 +189,18 @@ def self_test(noise: float) -> dict:
     assert not real["hard_regressions"], \
         (f"checked-in trajectory reads as a hard regression: "
          f"{real['hard_regressions']} — the guard would flap on CI")
+    # r08's fused-megachunk shape: the exact window-kernel ratchet rows
+    # extract, and the checked-in r07->r08 step compares clean
+    r08 = extract(json.loads((REPO / "BENCH_r08.json").read_text()))
+    assert {"fused_mega.window_kernels", "fused_mega.kernel_reduction",
+            "budget.mega_window_total",
+            "budget.xla_step_total"} <= set(r08), \
+        f"r08 extraction incomplete: {sorted(r08)}"
+    real8 = compare(r07, r08, noise)
+    assert real8["compared"] >= 1, "r07/r08 share no comparable metric"
+    assert not real8["fail"], \
+        (f"checked-in r07->r08 step reads as a regression: "
+         f"{real8['regressed']}")
     bad = {}
     for name, value in r07.items():
         if name in EXACT:
@@ -187,6 +213,14 @@ def self_test(noise: float) -> dict:
     assert synthetic["fail"], "synthetic 2x regression was NOT flagged"
     assert set(synthetic["regressed"]) == set(bad), \
         f"synthetic regression missed: {synthetic['regressed']}"
+    # the window-kernel ratchet: ONE extra kernel in the fused window
+    # must fail the guard outright (exact rows have no noise band)
+    crept = dict(r08)
+    crept["fused_mega.window_kernels"] += 1
+    ratchet = compare(r08, crept, noise)
+    assert ratchet["fail"] and \
+        "fused_mega.window_kernels" in ratchet["hard_regressions"], \
+        "a +1 window-kernel creep was NOT flagged as a hard regression"
     return {"real": real, "synthetic_flagged": synthetic["regressed"]}
 
 

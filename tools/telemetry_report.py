@@ -425,6 +425,22 @@ def summarize(path) -> dict:
             "fused_park_subset": metrics.get("device.fused_park_subset",
                                              0),
             "fused_park_mem": metrics.get("device.fused_park_mem", 0),
+            # fused megachunk windows (fuzz/megachunk.py fused=True):
+            # in-window quiesce dispatch split — Pallas kernel rounds vs
+            # XLA ladder/resume sweeps — and the machine/overlay bytes
+            # donation kept from copying through the kernel per dispatch
+            "fused_window_rounds": metrics.get(
+                "device.fused_window_rounds", 0),
+            "fused_window_xla_steps": metrics.get(
+                "device.fused_window_xla_steps", 0),
+            "fused_window_share": (
+                round(metrics.get("device.fused_window_rounds", 0)
+                      / (metrics.get("device.fused_window_rounds", 0)
+                         + metrics.get("device.fused_window_xla_steps",
+                                       0)), 4)
+                if metrics.get("device.fused_window_rounds", 0) else None),
+            "fused_window_bytes_saved": metrics.get(
+                "device.fused_window_bytes_saved", 0),
         },
         "mesh": mesh,
         "triage": triage,
@@ -493,6 +509,19 @@ def _print_human(s: dict) -> None:
     print(f"device counters: instructions={dev['instructions']} "
           f"mem_faults={dev['mem_faults']} "
           f"decode_misses={dev['decode_misses']}{fused}")
+    if dev.get("fused_window_share") is not None:
+        total = (dev["fused_window_rounds"]
+                 + dev["fused_window_xla_steps"])
+        print(f"  fused windows: {dev['fused_window_share'] * 100:.1f}% "
+              f"of {total} quiesce dispatches in-kernel "
+              f"({dev['fused_window_rounds']} pallas, "
+              f"{dev['fused_window_xla_steps']} ladder sweeps)")
+        saved = dev.get("fused_window_bytes_saved", 0)
+        if saved:
+            print(f"  donation: {saved / (1 << 20):.1f} MiB "
+                  f"copy-through saved "
+                  f"({saved // max(dev['fused_window_rounds'], 1)} "
+                  f"B/dispatch)")
     mesh = s.get("mesh")
     if mesh:
         print(f"mesh: {mesh['devices']} devices x "

@@ -107,6 +107,18 @@ MESH_DEVICES = 8
 MESH_CONFIG = dict(n_steps=16, lanes_per_shard=2,
                    uop_capacity=1 << 10, overlay_slots=8, edge_bits=12)
 
+# canonical fused-megachunk window configuration (fuzz/megachunk.py with
+# the Pallas step engine): the whole window program's data-dependent
+# JAXPR census — the Pallas dispatch counted ATOMICALLY as one
+# "pallas-call" (on hardware it IS one kernel; in interpret mode the
+# lowering would inline it and pollute an HLO census) — pinned as the
+# `megachunk_window_fused` entry, plus the two donation rules: every
+# pallas_call output aliased to its operand, and every machine/aggregate
+# leaf of the donate-lowered window executable aliased in the compiled
+# output (zero copy-through end to end).
+MEGA_ENTRY = "megachunk_window_fused"
+MEGA_CONFIG = dict(n_lanes=4, max_batches=2, limit=10_000)
+
 # canonical device-decode service configuration (wtf_tpu/interp/devdec):
 # ONE in-graph service round — the vmapped per-lane decode blocks plus
 # the sequential publish-order commit — lowered at the budget runner's
@@ -350,12 +362,14 @@ def count_data_dependent_ops(hlo_text: str) -> Dict[str, int]:
 
 
 def check_budget(counts: Dict[str, int], budget: Dict[str, int],
-                 entry: str) -> List[Finding]:
+                 entry: str, ops: Optional[Sequence[str]] = None
+                 ) -> List[Finding]:
     """Exact pin: any drift (up OR down) is a finding — an improvement
     must be re-baselined consciously (see PERF.md round 9), a regression
-    must be explained or fixed."""
+    must be explained or fixed.  `ops` extends the censused op set
+    (the fused-window entry adds "pallas-call")."""
     findings = []
-    for name in list(DATA_DEP_OPS) + ["total"]:
+    for name in list(ops if ops is not None else DATA_DEP_OPS) + ["total"]:
         got = counts.get(name, 0)
         want = budget.get(name)
         if want is None or got == want:
@@ -409,6 +423,188 @@ def run_decode_rules(runner, budgets_path: Optional[Path] = None,
         budget = load_budgets(budgets_path).get(DECODE_ENTRY, {})
         findings = check_budget(counts, budget, entry=info["entry"])
     return findings, info
+
+
+def _iter_eqns(jxp):
+    """Depth-first over a jaxpr's equations, descending into every
+    sub-jaxpr carried in params (while/cond/scan/pjit/custom calls) —
+    EXCEPT under pallas_call, which is atomic: on hardware it is ONE
+    kernel dispatch, so its internal jaxpr must not leak into a
+    kernel-count census."""
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    def sub_jaxprs(v):
+        if isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                yield from sub_jaxprs(x)
+
+    for eqn in jxp.eqns:
+        yield eqn
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for v in eqn.params.values():
+            for sub in sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def count_data_dependent_eqns(jaxpr) -> Dict[str, int]:
+    """JAXPR-level analogue of count_data_dependent_ops for programs
+    that embed a Pallas kernel: gather-class primitives counted across
+    every sub-jaxpr, each pallas_call counted as ONE "pallas-call"
+    (the fused window's per-round dispatch cost on hardware).  The HLO
+    census can't serve here — interpret-mode lowering inlines the
+    kernel body, which only exists on the CPU stand-in."""
+    jxp = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    counts = {name: 0 for name in DATA_DEP_OPS}
+    counts["pallas-call"] = 0
+    for eqn in _iter_eqns(jxp):
+        name = eqn.primitive.name
+        if name == "pallas_call":
+            counts["pallas-call"] += 1
+        elif name == "gather":
+            counts["gather"] += 1
+        elif name == "dynamic_slice":
+            counts["dynamic-slice"] += 1
+        elif name == "dynamic_update_slice":
+            counts["dynamic-update-slice"] += 1
+        elif name.startswith("scatter"):
+            counts["scatter"] += 1
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def check_pallas_aliasing(jaxpr, entry: str) -> List[Finding]:
+    """Every pallas_call in the fused window program must alias EVERY
+    output to an input operand (input_output_aliases) — an unaliased
+    output means the machine/overlay plane copies through the kernel on
+    each dispatch, the exact copy-through the fused-megachunk donation
+    leg eliminates.  A window with NO pallas_call is also a finding: the
+    census subject isn't actually running the kernel."""
+    jxp = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    findings: List[Finding] = []
+    n_calls = 0
+    for eqn in _iter_eqns(jxp):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        n_calls += 1
+        ioa = eqn.params.get("input_output_aliases") or ()
+        covered = {int(o) for (_i, o) in ioa}
+        missing = sorted(set(range(len(eqn.outvars))) - covered)
+        if missing:
+            findings.append(Finding(
+                rule="recompile.pallas-unaliased", entry=entry,
+                primitive=f"pallas_call outputs {missing}",
+                count=len(missing),
+                message=("fused-kernel output not aliased to its "
+                         "operand — the plane copies through the kernel "
+                         "every dispatch; extend input_output_aliases "
+                         "in pstep.fused_call_impl")))
+    if n_calls == 0:
+        findings.append(Finding(
+            rule="recompile.pallas-unaliased", entry=entry,
+            primitive="pallas_call",
+            message=("no pallas_call in the fused window program — the "
+                     "fused megachunk is not running the Pallas step "
+                     "engine; the pin's census subject is wrong")))
+    return findings
+
+
+def check_window_donation_aliasing(compiled, args,
+                                   donated: Sequence[int],
+                                   entry: str) -> List[Finding]:
+    """check_donation_aliasing generalized to the megachunk window
+    executable: every leaf of every donated operand position must appear
+    in the compiled module's input_output_alias map.  `args` is the full
+    operand tuple the window was lowered against; `donated` the
+    positional donate_argnums (megachunk.WINDOW_DONATE_ARGNUMS).  A
+    donated leaf jit's DCE pruned outright is still a finding — the
+    buffer is invalidated with no in-place reuse."""
+    import jax
+
+    text = compiled.as_text()
+    header = text[:text.index("\n")]
+    m = re.search(r"input_output_alias=\{(.*?)\}, entry_computation",
+                  header)
+    aliased = ({int(g.group(1)) for g in _ALIAS_ENTRY.finditer(m.group(1))}
+               if m else set())
+    kept = getattr(getattr(compiled, "_executable", None),
+                   "_kept_var_idx", None)
+    findings: List[Finding] = []
+    base = 0
+    names = ("tab", "image", "machine", "template", "slab_first",
+             "slab_rest", "seeds", "pfns", "gva_l", "finish", "limit",
+             "n_batches", "agg_cov", "agg_edge", "count", "bp_keys",
+             "n_bp")
+    for pos, arg in enumerate(args):
+        flat = jax.tree_util.tree_flatten_with_path(arg)[0]
+        for i, (path, _leaf) in enumerate(flat):
+            param = base + i
+            if pos in donated:
+                if kept is not None and param not in kept:
+                    shifted = -1  # pruned outright: never aliased
+                elif kept is not None:
+                    shifted = sum(1 for k in kept if k < param)
+                else:
+                    shifted = param
+                if shifted not in aliased:
+                    arg_name = (names[pos] if pos < len(names)
+                                else f"arg{pos}")
+                    findings.append(Finding(
+                        rule="recompile.window-donation-unaliased",
+                        entry=entry,
+                        primitive=(f"{arg_name}"
+                                   f"{jax.tree_util.keystr(path)} "
+                                   f"(param {param})"),
+                        message=("donated window operand leaf not "
+                                 "aliased in the compiled megachunk — "
+                                 "the buffer is invalidated without the "
+                                 "in-place reuse; the overlay/machine "
+                                 "planes would copy through the window "
+                                 "executable")))
+        base += len(flat)
+    return findings
+
+
+def run_megachunk_rules(budgets_path: Optional[Path] = None,
+                        rebaseline: bool = False
+                        ) -> Tuple[List[Finding], Dict]:
+    """The fused-window pins, one trace for all three:
+
+      1. the `megachunk_window_fused` kernel census (jaxpr-level,
+         pallas_call atomic) against budgets.json;
+      2. every pallas_call aliases all its machine-state outputs;
+      3. the window executable, LOWERED with donation (safe on CPU —
+         only execution is unsound there), aliases every donated
+         machine/aggregate leaf in its compiled output.
+
+    Returns (findings, info) with the measured counts for run_lint's
+    rebaseline merge."""
+    import jax
+
+    from wtf_tpu.analysis.trace import megachunk_window_lowering
+    from wtf_tpu.fuzz.megachunk import WINDOW_DONATE_ARGNUMS
+
+    cfg = MEGA_CONFIG
+    entry = (f"megachunk(max_batches={cfg['max_batches']}, fused=True, "
+             f"donate=True) / demo_tlv / n_lanes={cfg['n_lanes']}")
+    lowered, args, fn = megachunk_window_lowering(
+        max_batches=cfg["max_batches"], n_lanes=cfg["n_lanes"],
+        fused=True, donate=True, limit=cfg["limit"])
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    counts = count_data_dependent_eqns(jaxpr)
+    findings: List[Finding] = []
+    if not rebaseline:
+        budget = load_budgets(budgets_path).get(MEGA_ENTRY, {})
+        findings = check_budget(counts, budget, entry=entry,
+                                ops=list(DATA_DEP_OPS) + ["pallas-call"])
+    findings.extend(check_pallas_aliasing(jaxpr, entry=entry))
+    findings.extend(check_window_donation_aliasing(
+        lowered.compile(), args, WINDOW_DONATE_ARGNUMS, entry=entry))
+    return findings, {"mega_counts": counts, "entry": entry}
 
 
 def check_triage_chunk() -> List[Finding]:
@@ -1070,6 +1266,21 @@ def run_lint(families: Optional[Sequence[str]] = None,
                 "entry": decode_info["entry"], **counts_d}
         for name, value in counts_d.items():
             registry.gauge("analysis.decode_kernel_count").labels(
+                name).set(value)
+        # fused megachunk window (fuzz/megachunk.py fused=True): jaxpr
+        # census with pallas_call atomic, plus the two donation rules —
+        # kernel output aliasing and window-executable donation aliasing
+        mega_findings, mega_info = run_megachunk_rules(
+            budgets_path=budgets_path, rebaseline=rebaseline)
+        findings.extend(mega_findings)
+        counts_m = mega_info["mega_counts"]
+        info["mega_kernel_counts"] = counts_m
+        info["entries"].append(mega_info["entry"])
+        if rebaseline:
+            measured_budgets[MEGA_ENTRY] = {
+                "entry": mega_info["entry"], **counts_m}
+        for name, value in counts_m.items():
+            registry.gauge("analysis.mega_kernel_count").labels(
                 name).set(value)
         info["seconds"]["budget"] = round(time.time() - t0, 1)
 

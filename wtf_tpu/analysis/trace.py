@@ -207,3 +207,53 @@ def tenant_executor_lowering(runner, n_steps: int = 16,
     jitted = jax.jit(run_chunk, donate_argnums=(2,) if donate else ())
     return jitted.lower(runner.cache.device(), runner.image,
                         runner.machine, jnp.uint64(0))
+
+
+def megachunk_window_lowering(max_batches: int = 2, n_lanes: int = 4,
+                              fused: bool = True, donate: bool = True,
+                              limit: int = 10_000):
+    """Lower (without executing) ONE megachunk window program at the
+    canonical budget shapes: a demo_tlv devmangle campaign's window with
+    the requested step engine and donation policy.  Returns
+    (lowered, args, fn): the jax .lower() handle of the window
+    executable, the operand tuple it was lowered against (the donation
+    rules index its pytree structure), and the window callable itself
+    (the jaxpr census re-traces it).
+
+    Lowering WITH donation is safe on the CPU backend — only EXECUTION
+    of a donated program is unsound there (the PR-2 finding) — which is
+    why the runtime policy gates on the backend while this helper pins
+    the hardware posture statically."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from wtf_tpu.fuzz.megachunk import NO_FINISH, make_megachunk
+
+    loop = build_tlv_campaign(n_lanes=n_lanes, mutator="devmangle",
+                              limit=limit, megachunk=max_batches,
+                              fused_step="on" if fused else "off")
+    backend = loop.backend
+    runner = backend.runner
+    mutator = loop.mutator
+    spec = mutator.spec
+    n_pages = len(mutator.pfns)
+    fn = make_megachunk(max_batches, n_pages, spec.len_gpr,
+                        spec.ptr_gpr, mutator.rounds,
+                        deliver=runner.deliver_exceptions,
+                        devdec=runner.device_decode, fused=fused,
+                        fused_k=runner.fused_k,
+                        fused_resume_steps=runner.fused_resume_steps,
+                        donate=donate)
+    finish = spec.finish_gva if spec.finish_gva is not None else NO_FINISH
+    slab_first, slab_rest = mutator.window_slabs()
+    seeds = mutator.window_seeds(max_batches)
+    pfns = jnp.asarray(np.asarray(mutator.pfns, dtype=np.int32))
+    gva_l = jnp.asarray(np.array(
+        [spec.gva & 0xFFFF_FFFF, (spec.gva >> 32) & 0xFFFF_FFFF],
+        dtype=np.uint32))
+    args = (runner.device_tab(), runner.image, runner.machine,
+            runner.template, slab_first, slab_rest, seeds, pfns, gva_l,
+            jnp.uint64(finish), jnp.uint64(backend.limit),
+            jnp.int32(max_batches), backend._agg_cov, backend._agg_edge,
+            *runner.devdec_operands())
+    return fn.lower(*args), args, fn

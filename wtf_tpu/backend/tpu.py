@@ -298,7 +298,8 @@ class TpuBackend(Backend):
                                        spec.len_gpr, spec.ptr_gpr,
                                        mutator.rounds)
         key = ("megachunk", max_batches, n_pages, self.n_lanes,
-               mutator.rounds, runner.exec_sig)
+               mutator.rounds, runner.exec_sig,
+               bool(runner.fused_enabled), runner._donate)
         from wtf_tpu.interp.runner import _DISPATCHED_EXECUTORS
 
         if key not in _DISPATCHED_EXECUTORS:
@@ -359,6 +360,18 @@ class TpuBackend(Backend):
         statuses = np.asarray(jax.device_get(out.statuses))
         flags = np.asarray(jax.device_get(out.new_flags))
         ctr_sums = np.asarray(jax.device_get(out.ctr_sums))
+        # engine-round census: [XLA step_v sweeps, Pallas dispatches]
+        # over the whole window.  Every Pallas dispatch with aliased
+        # overlay/machine planes is one avoided copy-through of those
+        # buffers — the donation win the status/telemetry rows surface.
+        er = np.asarray(jax.device_get(out.engine_rounds))
+        self.registry.counter("device.fused_window_xla_steps").inc(
+            int(er[0]))
+        if int(er[1]):
+            self.registry.counter("device.fused_window_rounds").inc(
+                int(er[1]))
+            self.registry.counter("device.fused_window_bytes_saved").inc(
+                int(er[1]) * self._fused_alias_bytes())
         processed = b_done + (1 if incomplete else 0)
         mutator.consume_window(processed)
         if runner.device_decode and not incomplete:
@@ -376,10 +389,14 @@ class TpuBackend(Backend):
         # window's first batch is entitled to them, and its slab view is
         # only pinned during the loop's harvest.  Supervised or mesh
         # campaigns keep the synchronous schedule (recovery rebuilds and
-        # multi-chip placement interact badly with in-flight windows).
+        # multi-chip placement interact badly with in-flight windows),
+        # and so do DONATED windows: a dropped prelaunch discards its
+        # outputs, but donation has already consumed its input buffers —
+        # adopting nothing would leave the live machine invalidated.
         if (not incomplete and published == 0
                 and not flags[:b_done].any()
                 and not runner.supervisor.enabled
+                and not runner._donate
                 and runner.exec_sig == ()):
             n_out = self._dispatch_window(
                 fn, mutator, spec, n_pages, max_batches, n_batches,
@@ -472,10 +489,26 @@ class TpuBackend(Backend):
         """Everything a speculative window's operands were derived from:
         a prelaunched window is adopted only when the next call's
         signature is identical (same window size, same stream cursor,
-        same decode cache, same breakpoint set, same limit)."""
+        same decode cache, same breakpoint set, same limit, same step
+        engine — a degradation-ladder rung flip mid-campaign must drop
+        the speculative window, not adopt one built by the other
+        engine)."""
         cache = self.runner.cache
         return (max_batches, n_batches, n_pages, mutator._batch,
-                self.limit, cache.count, frozenset(cache.pending_bps))
+                self.limit, cache.count, frozenset(cache.pending_bps),
+                bool(self.runner.fused_enabled))
+
+    def _fused_alias_bytes(self) -> int:
+        """Bytes of the 13 machine/overlay planes the fused kernel
+        aliases in place (input_output_aliases) — the per-dispatch
+        copy-through the donation leg eliminates, dominated by the
+        `[lanes, slots, words]` overlay data slab."""
+        m = self.runner.machine
+        ov = m.overlay
+        leaves = (m.gpr_l, m.rip_l, m.rflags_l, m.status, m.icount,
+                  m.bp_skip, m.ctr, m.cov, m.edge, ov.pfn, ov.data,
+                  ov.valid, ov.count)
+        return int(sum(x.size * x.dtype.itemsize for x in leaves))
 
     def _harvest_device_decode(self, out) -> int:
         """Adopt the window's device-published decode entries into the

@@ -1190,6 +1190,20 @@ def _derived_status_rows(metrics: dict) -> List[str]:
         zh = val("devdec.zero_host_windows")
         rows.append(f"zero-host windows: {zh}/{windows} "
                     f"({zh / windows:.0%})")
+        # fused-window share: what fraction of in-window quiesce
+        # dispatches were Pallas kernel rounds vs XLA ladder sweeps
+        rounds = val("device.fused_window_rounds")
+        sweeps = val("device.fused_window_xla_steps")
+        if rounds:
+            rows.append(f"fused windows: "
+                        f"{rounds / (rounds + sweeps):.1%} of "
+                        f"{rounds + sweeps} quiesce dispatches "
+                        f"in-kernel")
+            saved = val("device.fused_window_bytes_saved")
+            if saved:
+                rows.append(f"donation: {saved / (1 << 20):.1f} MiB "
+                            f"copy-through saved "
+                            f"({saved // max(rounds, 1)} B/dispatch)")
         prelaunched = val("megachunk.prelaunched")
         if prelaunched:
             rows.append(f"prelaunch: "

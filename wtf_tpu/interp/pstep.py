@@ -679,9 +679,158 @@ def _build_kernel(k_steps: int, n_fields: int, hash_size: int,
     return kernel
 
 
+def fused_call_impl(tab: UopTable, image: MemImage, machine: Machine,
+                    limit, *, k_steps: int, interpret: bool):
+    """One fused-kernel dispatch, un-jitted: the bitcast pack seam, the
+    pallas_call, and the unpack seam back to a Machine.  Shared by the
+    jitted standalone executor (make_run_fused) and the fused megachunk
+    window body (fuzz/megachunk.py), which inlines this inside its own
+    while_loop so the kernel IS the window's step engine.
+
+    The kernel's machine-state operands (gpr..overlay, positions 11-23)
+    are aliased 1:1 to its 13 outputs via `input_output_aliases`, so the
+    `[lanes, slots, words]` overlay slab — the largest HBM-resident
+    operand — updates in place instead of copying through the kernel per
+    dispatch.  XLA still inserts a defensive copy when an operand is a
+    non-donated entry parameter; pairing this with donation on the
+    enclosing executable (the window's donate_argnums) removes that last
+    copy too."""
+    from jax.experimental import pallas as pl
+
+    n_lanes = machine.status.shape[0]
+    image = lane_image(image, n_lanes)
+    n_fields = tab.meta_i32.shape[1]
+    hash_size = tab.hash_tab.shape[0]
+    capacity = tab.rip_l.shape[0]
+    n_tenants, nframes = image.frame_table.shape
+    slots = machine.overlay.pfn.shape[1]
+    cov_w = machine.cov.shape[1]
+    edge_w = machine.edge.shape[1]
+    ebits = edge_w * 32
+    n_slots_img = image.pages.shape[0]
+    vwords = PAGE_WORDS // 4
+
+    # u64 leaves cross the kernel boundary as free u32 bitcasts; the
+    # overlay's u8 valid plane packs 4 bytes per u32 the same way
+    tmu32 = lax.bitcast_convert_type(
+        tab.meta_u64, jnp.uint32).reshape(capacity, 8)
+    pages32 = lax.bitcast_convert_type(
+        image.pages, jnp.uint32).reshape(n_slots_img, 2 * PAGE_WORDS)
+    ic32 = lax.bitcast_convert_type(machine.icount, jnp.uint32)
+    cr32 = lax.bitcast_convert_type(machine.cr3, jnp.uint32)
+    limit32 = lax.bitcast_convert_type(
+        jnp.asarray(limit, jnp.uint64).reshape(1),
+        jnp.uint32).reshape(2)
+    ov = machine.overlay
+    ovdata32 = lax.bitcast_convert_type(
+        ov.data, jnp.uint32).reshape(n_lanes, slots, 2 * PAGE_WORDS)
+    ovvalid32 = lax.bitcast_convert_type(
+        ov.valid.reshape(n_lanes, slots, vwords, 4), jnp.uint32)
+
+    kernel = _build_kernel(k_steps, n_fields, hash_size, nframes,
+                           ebits, slots)
+
+    def full(shape):
+        nd = len(shape)
+        return pl.BlockSpec(shape, lambda i, _n=nd: (0,) * _n)
+
+    def lane(shape_tail):
+        nd = 1 + len(shape_tail)
+        return pl.BlockSpec((1,) + shape_tail,
+                            lambda i, _n=nd: (i,) + (0,) * (_n - 1))
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_lanes,),
+        in_specs=[
+            full((hash_size, 3)),
+            full((capacity, 2)),
+            full((capacity, n_fields)),
+            full((capacity, 8)),
+            full((n_slots_img, 2 * PAGE_WORDS)),
+            full((n_tenants, nframes)),
+            full((2,)),
+            lane(()),
+            lane((2,)),
+            lane((2,)),
+            lane((2,)),
+            lane((16, 2)),
+            lane((2,)),
+            lane((2,)),
+            lane(()),
+            lane((2,)),
+            lane(()),
+            lane((N_CTRS,)),
+            lane((cov_w,)),
+            lane((edge_w,)),
+            lane((slots,)),
+            lane((slots, 2 * PAGE_WORDS)),
+            lane((slots, vwords)),
+            lane(()),
+        ],
+        out_specs=[
+            lane((16, 2)),
+            lane((2,)),
+            lane((2,)),
+            lane(()),
+            lane((2,)),
+            lane(()),
+            lane((N_CTRS,)),
+            lane((cov_w,)),
+            lane((edge_w,)),
+            lane((slots,)),
+            lane((slots, 2 * PAGE_WORDS)),
+            lane((slots, vwords)),
+            lane(()),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_lanes, 16, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes,), jnp.int32),
+            jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes,), jnp.int32),
+            jax.ShapeDtypeStruct((n_lanes, N_CTRS), jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes, cov_w), jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes, edge_w), jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes, slots), jnp.int32),
+            jax.ShapeDtypeStruct((n_lanes, slots, 2 * PAGE_WORDS),
+                                 jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes, slots, vwords),
+                                 jnp.uint32),
+            jax.ShapeDtypeStruct((n_lanes,), jnp.int32),
+        ],
+        interpret=interpret,
+        # machine-state operands 11..23 alias kernel outputs 0..12 — the
+        # overlay slab and machine planes update in place instead of
+        # copying through the kernel every dispatch
+        input_output_aliases={11 + i: i for i in range(13)},
+    )(tab.hash_tab, tab.rip_l, tab.meta_i32, tmu32, pages32,
+      image.frame_table, limit32, image.tenant, cr32,
+      machine.fs_base_l, machine.gs_base_l,
+      machine.gpr_l, machine.rip_l, machine.rflags_l, machine.status,
+      ic32, machine.bp_skip, machine.ctr, machine.cov, machine.edge,
+      ov.pfn, ovdata32, ovvalid32, ov.count)
+    (gpr_l, rip_l, rf_l, status, ic_out, bp_skip, ctr, cov, edge,
+     ovpfn, ovdata, ovvalid, ovcount) = out
+    overlay = ov._replace(
+        pfn=ovpfn,
+        data=lax.bitcast_convert_type(
+            ovdata.reshape(n_lanes, slots, PAGE_WORDS, 2),
+            jnp.uint64),
+        valid=lax.bitcast_convert_type(
+            ovvalid, jnp.uint8).reshape(n_lanes, slots, PAGE_WORDS),
+        count=ovcount)
+    return machine._replace(
+        gpr_l=gpr_l, rip_l=rip_l, rflags_l=rf_l, status=status,
+        icount=lax.bitcast_convert_type(ic_out, jnp.uint64),
+        bp_skip=bp_skip, ctr=ctr, cov=cov, edge=edge, overlay=overlay)
+
+
 def make_run_fused(k_steps: int, interpret: Optional[bool] = None):
     """Build (or fetch) the jitted fused-step executor: up to `k_steps`
-    hot-subset instructions per lane per dispatch.
+    hot-subset instructions per lane per dispatch.  Thin jit wrapper over
+    fused_call_impl (the megachunk window inlines the impl directly).
 
     `interpret=None` auto-selects: real Mosaic lowering on a TPU backend,
     the Pallas interpreter elsewhere (the tier-1/CPU validation mode)."""
@@ -692,137 +841,47 @@ def make_run_fused(k_steps: int, interpret: Optional[bool] = None):
     if cached is not None:
         return cached
 
-    from jax.experimental import pallas as pl
-
     @jax.jit
     def run_fused(tab: UopTable, image: MemImage, machine: Machine, limit):
-        n_lanes = machine.status.shape[0]
-        image = lane_image(image, n_lanes)
-        n_fields = tab.meta_i32.shape[1]
-        hash_size = tab.hash_tab.shape[0]
-        capacity = tab.rip_l.shape[0]
-        n_tenants, nframes = image.frame_table.shape
-        slots = machine.overlay.pfn.shape[1]
-        cov_w = machine.cov.shape[1]
-        edge_w = machine.edge.shape[1]
-        ebits = edge_w * 32
-        n_slots_img = image.pages.shape[0]
-        vwords = PAGE_WORDS // 4
-
-        # u64 leaves cross the kernel boundary as free u32 bitcasts; the
-        # overlay's u8 valid plane packs 4 bytes per u32 the same way
-        tmu32 = lax.bitcast_convert_type(
-            tab.meta_u64, jnp.uint32).reshape(capacity, 8)
-        pages32 = lax.bitcast_convert_type(
-            image.pages, jnp.uint32).reshape(n_slots_img, 2 * PAGE_WORDS)
-        ic32 = lax.bitcast_convert_type(machine.icount, jnp.uint32)
-        cr32 = lax.bitcast_convert_type(machine.cr3, jnp.uint32)
-        limit32 = lax.bitcast_convert_type(
-            jnp.asarray(limit, jnp.uint64).reshape(1),
-            jnp.uint32).reshape(2)
-        ov = machine.overlay
-        ovdata32 = lax.bitcast_convert_type(
-            ov.data, jnp.uint32).reshape(n_lanes, slots, 2 * PAGE_WORDS)
-        ovvalid32 = lax.bitcast_convert_type(
-            ov.valid.reshape(n_lanes, slots, vwords, 4), jnp.uint32)
-
-        kernel = _build_kernel(k_steps, n_fields, hash_size, nframes,
-                               ebits, slots)
-
-        def full(shape):
-            nd = len(shape)
-            return pl.BlockSpec(shape, lambda i, _n=nd: (0,) * _n)
-
-        def lane(shape_tail):
-            nd = 1 + len(shape_tail)
-            return pl.BlockSpec((1,) + shape_tail,
-                                lambda i, _n=nd: (i,) + (0,) * (_n - 1))
-
-        out = pl.pallas_call(
-            kernel,
-            grid=(n_lanes,),
-            in_specs=[
-                full((hash_size, 3)),
-                full((capacity, 2)),
-                full((capacity, n_fields)),
-                full((capacity, 8)),
-                full((n_slots_img, 2 * PAGE_WORDS)),
-                full((n_tenants, nframes)),
-                full((2,)),
-                lane(()),
-                lane((2,)),
-                lane((2,)),
-                lane((2,)),
-                lane((16, 2)),
-                lane((2,)),
-                lane((2,)),
-                lane(()),
-                lane((2,)),
-                lane(()),
-                lane((N_CTRS,)),
-                lane((cov_w,)),
-                lane((edge_w,)),
-                lane((slots,)),
-                lane((slots, 2 * PAGE_WORDS)),
-                lane((slots, vwords)),
-                lane(()),
-            ],
-            out_specs=[
-                lane((16, 2)),
-                lane((2,)),
-                lane((2,)),
-                lane(()),
-                lane((2,)),
-                lane(()),
-                lane((N_CTRS,)),
-                lane((cov_w,)),
-                lane((edge_w,)),
-                lane((slots,)),
-                lane((slots, 2 * PAGE_WORDS)),
-                lane((slots, vwords)),
-                lane(()),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((n_lanes, 16, 2), jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes,), jnp.int32),
-                jax.ShapeDtypeStruct((n_lanes, 2), jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes,), jnp.int32),
-                jax.ShapeDtypeStruct((n_lanes, N_CTRS), jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes, cov_w), jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes, edge_w), jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes, slots), jnp.int32),
-                jax.ShapeDtypeStruct((n_lanes, slots, 2 * PAGE_WORDS),
-                                     jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes, slots, vwords),
-                                     jnp.uint32),
-                jax.ShapeDtypeStruct((n_lanes,), jnp.int32),
-            ],
-            interpret=interpret,
-        )(tab.hash_tab, tab.rip_l, tab.meta_i32, tmu32, pages32,
-          image.frame_table, limit32, image.tenant, cr32,
-          machine.fs_base_l, machine.gs_base_l,
-          machine.gpr_l, machine.rip_l, machine.rflags_l, machine.status,
-          ic32, machine.bp_skip, machine.ctr, machine.cov, machine.edge,
-          ov.pfn, ovdata32, ovvalid32, ov.count)
-        (gpr_l, rip_l, rf_l, status, ic_out, bp_skip, ctr, cov, edge,
-         ovpfn, ovdata, ovvalid, ovcount) = out
-        overlay = ov._replace(
-            pfn=ovpfn,
-            data=lax.bitcast_convert_type(
-                ovdata.reshape(n_lanes, slots, PAGE_WORDS, 2),
-                jnp.uint64),
-            valid=lax.bitcast_convert_type(
-                ovvalid, jnp.uint8).reshape(n_lanes, slots, PAGE_WORDS),
-            count=ovcount)
-        return machine._replace(
-            gpr_l=gpr_l, rip_l=rip_l, rflags_l=rf_l, status=status,
-            icount=lax.bitcast_convert_type(ic_out, jnp.uint64),
-            bp_skip=bp_skip, ctr=ctr, cov=cov, edge=edge, overlay=overlay)
+        return fused_call_impl(tab, image, machine, limit,
+                               k_steps=k_steps, interpret=interpret)
 
     _FUSED_CACHE[key] = run_fused
     return run_fused
+
+
+def fused_resume_impl(tab: UopTable, image: MemImage, machine: Machine,
+                      limit, *, n_steps: int):
+    """The resume leg, un-jitted: returns (machine, xla_sweeps) where
+    xla_sweeps counts the step_v iterations the bounded while executed —
+    the fused window's ladder-engine round currency.  Shared by the
+    jitted standalone leg (make_run_resume, which discards the count)
+    and the fused megachunk window body."""
+    from wtf_tpu.interp.step import step_lane
+
+    step_v = jax.vmap(step_lane, in_axes=(None, IMAGE_IN_AXES, 0, None))
+    running = jnp.int32(_RUNNING)
+    parked = jnp.int32(_NEEDS_XLA)
+
+    image = lane_image(image, machine.status.shape[0])
+    st = machine.status
+    machine = machine._replace(status=jnp.where(
+        st == parked, running, jnp.where(st == running, parked, st)))
+
+    def cond(carry):
+        i, m = carry
+        return (i < n_steps) & jnp.any(m.status == running)
+
+    def body(carry):
+        i, m = carry
+        return i + 1, step_v(tab, image, m, limit)
+
+    iters, out = lax.while_loop(cond, body, (jnp.int32(0), machine))
+    # release held lanes (step_lane never emits NEEDS_XLA itself, so
+    # every remaining NEEDS_XLA is a lane held above)
+    out = out._replace(status=jnp.where(
+        out.status == parked, running, out.status))
+    return out, iters
 
 
 def make_run_resume(n_steps: int, donate: bool = None):
@@ -851,32 +910,11 @@ def make_run_resume(n_steps: int, donate: bool = None):
 
     from functools import partial
 
-    from wtf_tpu.interp.step import step_lane
-
-    step_v = jax.vmap(step_lane, in_axes=(None, IMAGE_IN_AXES, 0, None))
-    running = jnp.int32(_RUNNING)
-    parked = jnp.int32(_NEEDS_XLA)
-
     @partial(jax.jit, donate_argnums=(2,) if donate else ())
     def run_resume(tab: UopTable, image: MemImage, machine: Machine, limit):
-        image = lane_image(image, machine.status.shape[0])
-        st = machine.status
-        machine = machine._replace(status=jnp.where(
-            st == parked, running, jnp.where(st == running, parked, st)))
-
-        def cond(carry):
-            i, m = carry
-            return (i < n_steps) & jnp.any(m.status == running)
-
-        def body(carry):
-            i, m = carry
-            return i + 1, step_v(tab, image, m, limit)
-
-        _, out = lax.while_loop(cond, body, (jnp.int32(0), machine))
-        # release held lanes (step_lane never emits NEEDS_XLA itself, so
-        # every remaining NEEDS_XLA is a lane held above)
-        return out._replace(status=jnp.where(
-            out.status == parked, running, out.status))
+        out, _ = fused_resume_impl(tab, image, machine, limit,
+                                   n_steps=n_steps)
+        return out
 
     _RESUME_CACHE[key] = run_resume
     return run_resume

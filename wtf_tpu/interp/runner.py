@@ -823,12 +823,19 @@ class Runner:
         """The one-dispatch multi-batch window program (wtf_tpu/fuzz/
         megachunk.py) — the seam the megachunk driver dispatches, so
         mesh runners can swap in the shard_map variant with the same
-        signature."""
+        signature.  `fused_enabled` is read HERE, at call time, so the
+        degradation ladder's no-fused rung (supervise.DegradationLadder
+        toggling runner.fused_enabled) also swaps the window's step
+        engine back to the XLA ladder."""
         from wtf_tpu.fuzz.megachunk import make_megachunk
 
         return make_megachunk(max_batches, n_pages, len_gpr, ptr_gpr,
                               rounds, deliver=self.deliver_exceptions,
-                              devdec=self.device_decode)
+                              devdec=self.device_decode,
+                              fused=bool(self.fused_enabled),
+                              fused_k=self.fused_k,
+                              fused_resume_steps=self.fused_resume_steps,
+                              donate=self._donate)
 
     def devdec_operands(self) -> Tuple:
         """Extra megachunk operands for the in-graph decoder: the live

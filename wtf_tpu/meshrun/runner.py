@@ -142,7 +142,12 @@ class MeshRunner(Runner):
                                    ptr_gpr, rounds,
                                    deliver=self.deliver_exceptions,
                                    mesh=self.mesh,
-                                   devdec=self.device_decode)
+                                   devdec=self.device_decode,
+                                   fused=bool(self.fused_enabled),
+                                   fused_k=self.fused_k,
+                                   fused_resume_steps=(
+                                       self.fused_resume_steps),
+                                   donate=self._donate)
 
     def megachunk_place(self, slab_first, slab_rest, seeds):
         """Place one window's operands: slabs replicated (version-

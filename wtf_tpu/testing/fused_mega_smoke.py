@@ -1,0 +1,112 @@
+"""Fused-megachunk smoke (`make fused-mega-smoke`, wired into
+`make verify`).
+
+PR 19's three window-level bars, CPU-only, interpret mode, no hardware:
+
+  parity      a devmangle campaign through megachunk windows whose
+              quiesce body is the Pallas fused kernel + bounded resume
+              (fused_step=on) must be bit-identical to the XLA-ladder
+              window campaign at equal seeds — aggregate coverage/edge
+              bitmap bytes, corpus digests, crash buckets, every
+              counter — and must actually dispatch the kernel
+              (device.fused_window_rounds > 0), with the donation
+              bookkeeping exact (bytes-saved = rounds x aliased plane
+              bytes);
+  occupancy   >= 0.95 of the fused campaign's retired instructions
+              retire INSIDE the kernel (device.fused_steps /
+              device.instructions) — the windows run the kernel, not
+              the park-resume path;
+  donation    `run_megachunk_rules` is clean: the jaxpr kernel census
+              matches the budgets.json `megachunk_window_fused` pin,
+              every pallas_call output is aliased to its operand, and
+              every donated machine/aggregate leaf is aliased in the
+              compiled window executable (zero copy-through).
+
+Exit 0 = all held; any assertion prints and exits 1.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _parity_and_occupancy_leg() -> None:
+    from wtf_tpu.analysis.trace import build_tlv_campaign
+    from wtf_tpu.utils.hashing import hex_digest
+
+    def campaign(mode):
+        loop = build_tlv_campaign(
+            mutator="devmangle", seed=0x5EED, megachunk=3, n_lanes=4,
+            limit=10_000, chunk_steps=128, overlay_slots=16,
+            fused_step=mode)
+        # 8 batches: finds land in IN-GRAPH batches, so the find-stop
+        # slab seam — where fused/ladder skew would surface — is hit
+        loop.fuzz(runs=4 * 8)
+        cov, edge = loop.backend.coverage_state()
+        return loop, {
+            "cov": cov.tobytes(), "edge": edge.tobytes(),
+            "corpus": [hex_digest(d) for d in loop.corpus],
+            "buckets": sorted(loop.crash_buckets),
+            "testcases": loop.stats.testcases,
+            "crashes": loop.stats.crashes,
+            "timeouts": loop.stats.timeouts,
+        }
+
+    ladder, fp_ladder = campaign("off")
+    fused, fp_fused = campaign("on")
+    for key in fp_ladder:
+        assert fp_fused[key] == fp_ladder[key], (
+            f"fused window diverged from the ladder window on {key}")
+    reg = fused.registry
+    rounds = int(reg.counter("device.fused_window_rounds").value)
+    assert rounds > 0, "fused campaign never dispatched the kernel"
+    assert int(ladder.registry.counter(
+        "device.fused_window_rounds").value) == 0
+    saved = int(reg.counter("device.fused_window_bytes_saved").value)
+    per = fused.backend._fused_alias_bytes()
+    assert saved == rounds * per, (
+        f"donation bytes-saved {saved} != {rounds} rounds x {per} "
+        f"aliased plane bytes")
+    print(f"[fused-mega-smoke] fused-window parity held "
+          f"({fp_ladder['testcases']} testcases, {rounds} kernel "
+          f"dispatches, {saved} donated bytes kept in place)")
+
+    instr = int(reg.counter("device.instructions").value)
+    in_kernel = int(reg.counter("device.fused_steps").value)
+    occ = in_kernel / max(instr, 1)
+    print(f"[fused-mega-smoke] in-window occupancy {occ:.4f} "
+          f"({in_kernel}/{instr} retired in-kernel)")
+    assert instr > 1000, "campaign barely ran"
+    assert occ >= 0.95, (
+        f"in-window occupancy {occ:.4f} < 0.95 — lanes are retiring on "
+        f"the park-resume leg instead of inside the kernel")
+
+
+def _donation_lint_leg() -> None:
+    from wtf_tpu.analysis.rules import run_megachunk_rules
+
+    findings, info = run_megachunk_rules()
+    assert not findings, (
+        "megachunk donation/budget rules not clean: "
+        + "; ".join(f.message for f in findings))
+    counts = info["mega_counts"]
+    assert counts["pallas-call"] >= 1
+    print(f"[fused-mega-smoke] donation lint clean "
+          f"({counts['total']} census ops incl. "
+          f"{counts['pallas-call']} pallas-call; every donated leaf "
+          f"aliased in the compiled window)")
+
+
+def main() -> int:
+    try:
+        _parity_and_occupancy_leg()
+        _donation_lint_leg()
+    except AssertionError as e:
+        print(f"[fused-mega-smoke] FAILED: {e}")
+        return 1
+    print("[fused-mega-smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
