@@ -22,11 +22,17 @@ test:
 
 # hot-path contract lint: fails (exit 1) on ANY finding.  JSON output so
 # CI logs carry the kernel counts + finding provenance machine-readably.
+# All families run, including the dataflow contract trio
+# (state/transfer/thread) and the contracts.json hygiene family; the
+# jaxpr host-transfer census rides the budget family's traces for free,
+# so --deep is only needed when filtering the budget family out.
 lint:
-	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.analysis --json
+	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.analysis --json --deep
 
-# re-pin analysis/budgets.json after a PR that legitimately changes the
-# step ladder's kernel count — record the why in PERF.md (round 9)
+# re-pin analysis/budgets.json AND analysis/contracts.json after a PR
+# that legitimately changes the step ladder's kernel count or the
+# contract surfaces — record the why in PERF.md (rounds 9 and 21).
+# Both files ratchet: growth requires --allow-regression.
 lint-rebaseline:
 	JAX_PLATFORMS=cpu $(PY) -m wtf_tpu.analysis --rebaseline
 

@@ -56,7 +56,16 @@ HIGHER_BETTER = {
 # counter-derived at equal seeds (fused_mega) or census pins: any
 # increase is a real step-graph/dispatch regression, no noise excuse
 EXACT = {"budget.xla_step_total", "budget.mega_window_total",
-         "fused_mega.window_kernels"}
+         "fused_mega.window_kernels",
+         # jaxpr host-transfer census (wtf-tpu lint transfer family):
+         # a +1 on any program is a hidden device->host sync in the
+         # zero-host steady state — deterministic, zero noise excuse
+         "transfer.megachunk_window_fused", "transfer.devmut_generate",
+         "transfer.device_insert", "transfer.decode_service",
+         "transfer.total"}
+
+_CENSUS_KEYS = ("megachunk_window_fused", "devmut_generate",
+                "device_insert", "decode_service", "total")
 
 _MICRO_KEYS = ("branchy_instr_per_s", "chunk512_wall_s",
                "chunk_dispatch_floor_s")
@@ -113,6 +122,13 @@ def extract(doc: dict) -> dict:
         value = _num(budget.get(src))
         if value is not None:
             out[dst] = value
+    # host-transfer census rows: present in `wtf-tpu lint --json` output
+    # (transfer family) and in bench rounds that embed it
+    census = doc.get("transfer_census") or {}
+    for src in _CENSUS_KEYS:
+        value = _num(census.get(src))
+        if value is not None:
+            out[f"transfer.{src}"] = value
     return out
 
 
@@ -221,6 +237,21 @@ def self_test(noise: float) -> dict:
     assert ratchet["fail"] and \
         "fused_mega.window_kernels" in ratchet["hard_regressions"], \
         "a +1 window-kernel creep was NOT flagged as a hard regression"
+    # the transfer-census ratchet: rows extract from lint-shaped docs
+    # and a single extra host transfer is a hard regression
+    lint_doc = {"transfer_census": {
+        "megachunk_window_fused": 5, "devmut_generate": 2,
+        "device_insert": 0, "decode_service": 0, "total": 7}}
+    census = extract(lint_doc)
+    assert {"transfer.megachunk_window_fused", "transfer.total"} <= \
+        set(census), f"census extraction incomplete: {sorted(census)}"
+    leaked = dict(census)
+    leaked["transfer.megachunk_window_fused"] += 1
+    leaked["transfer.total"] += 1
+    tguard = compare(census, leaked, noise)
+    assert tguard["fail"] and \
+        "transfer.total" in tguard["hard_regressions"], \
+        "a +1 host-transfer creep was NOT flagged as a hard regression"
     return {"real": real, "synthetic_flagged": synthetic["regressed"]}
 
 

@@ -14,10 +14,16 @@ Entry points:
     wtf-tpu lint ...                    (installed console script)
 
 Rule families (wtf_tpu/analysis/rules.py): dtype, budget, recompile,
-parity, mesh, supervise.  Budgets live in wtf_tpu/analysis/budgets.json;
-re-baseline with
-`--rebaseline` when a PR legitimately changes kernel count (PERF.md
-round 9 documents the procedure).
+parity, mesh, supervise, telemetry, plus the dataflow contract families
+(wtf_tpu/analysis/contracts.py on the shared engine in flow.py): state,
+transfer, thread, contracts.  Kernel/collective/transfer budgets live in
+wtf_tpu/analysis/budgets.json and the state/transfer/thread allowlists
+in wtf_tpu/analysis/contracts.json; re-baseline with `--rebaseline` when
+a PR legitimately changes them (PERF.md rounds 9 and 21 document the
+procedure — both files are ratchets: growth needs --allow-regression).
+`--deep` adds the jaxpr host-transfer census to a transfer-family run
+that skips the budget family; `--sarif OUT.json` additionally writes
+the findings as SARIF 2.1.0 for review-annotation pipelines.
 """
 
 from __future__ import annotations
@@ -64,12 +70,22 @@ def _build_parser() -> argparse.ArgumentParser:
                         "name the reason in PERF.md)")
     p.add_argument("--telemetry-dir", default=None,
                    help="write lint findings as events.jsonl records")
+    p.add_argument("--deep", action="store_true",
+                   help="run the transfer family's jaxpr host-transfer "
+                        "census even without the budget family (whose "
+                        "fused-window trace it would otherwise reuse)")
+    p.add_argument("--sarif", default=None, metavar="OUT.json",
+                   help="also write the findings as a SARIF 2.1.0 "
+                        "document (file:line provenance mapped to "
+                        "physical locations)")
     return p
 
 
 def lint_main(families=None, budgets=None, rebaseline: bool = False,
               allow_regression: bool = False,
-              as_json: bool = False, registry=None, events=None,
+              as_json: bool = False, deep: bool = False,
+              sarif: Optional[str] = None,
+              registry=None, events=None,
               out=None) -> int:
     """Run the lint and print results; returns the process exit code
     (0 clean, 1 findings).  Shared by `python -m wtf_tpu.analysis` and
@@ -94,6 +110,7 @@ def lint_main(families=None, budgets=None, rebaseline: bool = False,
         findings, info = run_lint(families=families, budgets_path=budgets,
                                   rebaseline=rebaseline,
                                   allow_regression=allow_regression,
+                                  deep=deep,
                                   registry=registry, events=events)
     except ValueError as e:
         # operator-facing refusals (the rebaseline ratchet, bad family
@@ -101,6 +118,13 @@ def lint_main(families=None, budgets=None, rebaseline: bool = False,
         print(f"wtf-tpu lint: {e}", file=out)
         return 1
     wall = round(time.time() - t0, 1)
+    if sarif:
+        from pathlib import Path
+
+        from wtf_tpu.analysis.findings import to_sarif
+
+        Path(sarif).write_text(
+            json.dumps(to_sarif(findings), indent=2) + "\n")
     if as_json:
         print(json.dumps({
             "clean": not findings, "wall_seconds": wall,
@@ -117,8 +141,15 @@ def lint_main(families=None, budgets=None, rebaseline: bool = False,
         if collectives:
             print("mesh collectives: " + " ".join(
                 f"{k}={v}" for k, v in collectives.items()), file=out)
+        census = info.get("transfer_census")
+        if census:
+            print("transfer census: " + " ".join(
+                f"{k}={v}" for k, v in census.items()), file=out)
         if "budgets_written" in info:
             print(f"re-baselined -> {info['budgets_written']}", file=out)
+        if "contracts_written" in info:
+            print(f"re-baselined -> {info['contracts_written']}",
+                  file=out)
         state = ("CLEAN" if not findings
                  else f"{len(findings)} finding(s)")
         print(f"wtf-tpu lint: {state} "
@@ -139,7 +170,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return lint_main(families=families, budgets=args.budgets,
                          rebaseline=args.rebaseline,
                          allow_regression=args.allow_regression,
-                         as_json=args.json,
+                         as_json=args.json, deep=args.deep,
+                         sarif=args.sarif,
                          registry=registry, events=events)
     finally:
         events.emit("run-end", metrics=registry.dump())

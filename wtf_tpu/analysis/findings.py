@@ -7,12 +7,15 @@ offending primitive — so a regression shows up in CI as e.g.
     dtype.no-u64 @ step.alu_limb [u64[] add]: 64-bit integer op in ported path
 
 instead of a 2x wall-clock surprise on real hardware five PRs later.
+The dataflow families (state/transfer/thread, wtf_tpu/analysis/
+contracts.py) additionally carry file:line provenance, which the SARIF
+output mode maps to physical locations for review annotation.
 """
 
 from __future__ import annotations
 
 from dataclasses import asdict, dataclass
-from typing import Optional
+from typing import List, Optional
 
 
 @dataclass
@@ -23,6 +26,8 @@ class Finding:
     primitive: Optional[str] = None  # offending HLO op / dtype / opclass
     count: Optional[int] = None      # measured value (budget rules)
     budget: Optional[int] = None     # pinned value  (budget rules)
+    file: Optional[str] = None       # source file (dataflow families)
+    line: Optional[int] = None       # 1-based line in `file`
 
     def as_dict(self) -> dict:
         return {k: v for k, v in asdict(self).items() if v is not None}
@@ -31,4 +36,40 @@ class Finding:
         extra = f" [{self.primitive}]" if self.primitive else ""
         vs = (f" (measured {self.count} vs budget {self.budget})"
               if self.count is not None and self.budget is not None else "")
-        return f"{self.rule} @ {self.entry}{extra}: {self.message}{vs}"
+        loc = f" ({self.file}:{self.line})" if self.file else ""
+        return f"{self.rule} @ {self.entry}{extra}: {self.message}{vs}{loc}"
+
+
+def to_sarif(findings: List[Finding], tool_version: str = "0") -> dict:
+    """SARIF 2.1.0 document for review-annotation pipelines — one result
+    per finding, physical location attached when the rule carries
+    file:line provenance."""
+    results = []
+    for f in findings:
+        result: dict = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": str(f)},
+        }
+        if f.file:
+            result["locations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.file},
+                    "region": {"startLine": int(f.line or 1)},
+                },
+            }]
+        results.append(result)
+    rules = sorted({f.rule for f in findings})
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "wtf-tpu-lint",
+                "version": tool_version,
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": results,
+        }],
+    }

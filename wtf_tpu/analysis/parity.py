@@ -56,30 +56,11 @@ def _resolved_opc_names(src: str, target: str) -> Set[str]:
     intermediate Name bindings transitively (the house style routes
     predicates through locals — `movcr_bad`, `x87_oracle` — and builds
     with `|=` sometimes; a literal-only walk of one RHS would be blind to
-    both)."""
-    defs: dict = {}
-    for node in ast.walk(ast.parse(src)):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    defs.setdefault(t.id, []).append(node.value)
-        elif isinstance(node, ast.AugAssign):
-            if isinstance(node.target, ast.Name):
-                defs.setdefault(node.target.id, []).append(node.value)
-    if target not in defs:
-        raise ValueError(f"no `{target} = ...` assignment found in source")
-    names: Set[str] = set()
-    seen = {target}
-    work = [target]
-    while work:
-        for rhs in defs[work.pop()]:
-            names |= _opc_names(rhs)
-            for sub in ast.walk(rhs):
-                if (isinstance(sub, ast.Name) and sub.id in defs
-                        and sub.id not in seen):
-                    seen.add(sub.id)
-                    work.append(sub.id)
-    return names
+    both).  Delegates to the shared dataflow engine (analysis/flow.py),
+    where the worklist resolver this family pioneered now lives."""
+    from wtf_tpu.analysis import flow
+
+    return flow.resolve_transitive(src, target, _opc_names)
 
 
 def _module_src(modname: str) -> str:

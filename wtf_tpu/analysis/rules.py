@@ -47,6 +47,22 @@ the result:
             the <1% observability-overhead bar holds because
             serialization rides the heartbeat/TAG_TELEM cadence; same
             SEAM_SITES enumeration as the supervise family
+  state     every mutable attribute on the campaign objects is either
+            carried by the checkpoint/restore/recovery field sets or
+            declared derived/transient in analysis/contracts.json
+            (wtf_tpu/analysis/contracts.py, on the shared dataflow
+            engine in wtf_tpu/analysis/flow.py)
+  transfer  no dispatch seam grows a hidden device->host sync: AST
+            coercion census over SEAM_SITES against the contracts.json
+            allowlist, plus (with --deep, or for free when the budget
+            family runs) the jaxpr host-callback census of the
+            steady-state programs pinned under budgets.json's
+            `host_transfer` entry
+  thread    attributes shared across declared host-thread roots
+            (watchdog, prelaunch, reactor, reconnect…) must appear in
+            the contracts.json ownership/lock table
+  contracts the contract tables themselves: stale rows, undocumented
+            reasons, unknown dispositions — the allowlist cannot rot
 
 `run_lint` orchestrates all families and reports Findings; helpers are
 public so tests can seed violations directly.
@@ -129,7 +145,12 @@ DECODE_ENTRY = "decode_service"
 DECODE_BP_SLOTS = 8
 
 FAMILIES = ("dtype", "budget", "recompile", "parity", "mesh", "supervise",
-            "telemetry")
+            "telemetry", "state", "transfer", "thread", "contracts")
+
+# families that (re)write budgets.json vs analysis/contracts.json on
+# --rebaseline — the guard below demands at least one of them
+_BUDGET_FAMILIES = frozenset(("budget", "mesh", "transfer"))
+_CONTRACT_FAMILIES = frozenset(("state", "transfer", "thread"))
 
 _FORBID_64 = re.compile(r"\b(u64|s64|f64|f32)\[")
 # jaxpr primitives that move/reshape bits without computing on them (the
@@ -604,7 +625,10 @@ def run_megachunk_rules(budgets_path: Optional[Path] = None,
     findings.extend(check_pallas_aliasing(jaxpr, entry=entry))
     findings.extend(check_window_donation_aliasing(
         lowered.compile(), args, WINDOW_DONATE_ARGNUMS, entry=entry))
-    return findings, {"mega_counts": counts, "entry": entry}
+    # the raw jaxpr rides along (popped before JSON) so the transfer
+    # family's host-callback census never re-traces the window
+    return findings, {"mega_counts": counts, "entry": entry,
+                      "jaxpr": jaxpr}
 
 
 def check_triage_chunk() -> List[Finding]:
@@ -653,26 +677,22 @@ def check_supervised_seams(sites: Optional[Dict[str, str]] = None
     contract is only as strong as its seam coverage, so the enumeration
     is an export hook (supervise.SEAM_SITES, the PORTED_LIMB_PATHS
     mechanism): a new dispatch seam must be listed there AND its listed
-    site must contain the literal `supervisor.dispatch("<seam>"...)`
-    routing call.  Statically, by source inspection — the seams include
-    paths (mesh, fused) a CPU lint run never executes.  `sites`
-    parameterizes the enumeration for rule tests."""
-    import importlib
-    import inspect
+    site must contain a `supervisor.dispatch("<seam>"...)` routing
+    call.  AST-level on the shared dataflow engine (analysis/flow.py) —
+    the seams include paths (mesh, fused) a CPU lint run never
+    executes, and the AST walk cannot false-hit on comments or
+    docstrings the way the retired regex could.  `sites` parameterizes
+    the enumeration for rule tests."""
+    from wtf_tpu.analysis import flow
 
     if sites is None:
         from wtf_tpu.supervise import SEAM_SITES
 
         sites = SEAM_SITES
     findings: List[Finding] = []
-    dispatch_re = re.compile(r"supervisor\s*\.\s*dispatch\(")
     for seam, site in sorted(sites.items()):
-        mod_name, _, qual = site.partition(":")
         try:
-            obj = importlib.import_module(mod_name)
-            for part in qual.split("."):
-                obj = getattr(obj, part)
-            src = inspect.getsource(obj)
+            info = flow.resolve_site(site)
         except Exception as e:  # unresolvable site IS the finding
             findings.append(Finding(
                 rule="supervise.seam-routing", entry=site, primitive=seam,
@@ -680,9 +700,10 @@ def check_supervised_seams(sites: Optional[Dict[str, str]] = None
                          "supervise.SEAM_SITES must name the live "
                          "module:Class.method of every dispatch seam")))
             continue
-        if not (dispatch_re.search(src) and f'"{seam}"' in src):
+        if seam not in flow.dispatch_seams(info.node):
             findings.append(Finding(
                 rule="supervise.seam-routing", entry=site, primitive=seam,
+                file=info.file, line=info.lineno,
                 message=(f"dispatch seam {seam!r} does not route through "
                          "Supervisor.dispatch — a hang/error/poison here "
                          "would bypass watchdog + rebuild-and-replay "
@@ -691,25 +712,20 @@ def check_supervised_seams(sites: Optional[Dict[str, str]] = None
     return findings
 
 
-# registry-serialization surface: building a wire/export payload from
-# the metric registry.  Counter bumps (`.inc()`, `.set()`) are O(1) dict
-# ops and welcome anywhere; these are O(registry) + JSON and are not.
-_TELEM_SERIALIZE = re.compile(
-    r"\.snapshot\(|encode_telem\(|render_prometheus\(|json\.dumps\(")
-
-
 def check_telemetry_seams(sites: Optional[Dict[str, str]] = None
                           ) -> List[Finding]:
     """No supervised dispatch seam may serialize the metric registry
     inline: `.snapshot()` walks every metric, `encode_telem`/`json.dumps`
     pay JSON, and the seams run once per chunk — the <1% overhead bar
     (PERF.md) holds because serialization rides the heartbeat/TAG_TELEM
-    cadence (seconds) instead.  Statically, over the same
-    supervise.SEAM_SITES enumeration the routing rule walks, so a new
-    dispatch seam is covered the moment it is enumerated.  `sites`
-    parameterizes the enumeration for rule tests."""
-    import importlib
-    import inspect
+    cadence (seconds) instead.  AST-level (flow.serialization_calls —
+    counter bumps like `.inc()`/`.set()` are O(1) dict ops and welcome
+    anywhere; registry snapshots / telem encoding / json.dumps are
+    O(registry)+JSON and are not), over the same supervise.SEAM_SITES
+    enumeration the routing rule walks, so a new dispatch seam is
+    covered the moment it is enumerated.  `sites` parameterizes the
+    enumeration for rule tests."""
+    from wtf_tpu.analysis import flow
 
     if sites is None:
         from wtf_tpu.supervise import SEAM_SITES
@@ -717,22 +733,20 @@ def check_telemetry_seams(sites: Optional[Dict[str, str]] = None
         sites = SEAM_SITES
     findings: List[Finding] = []
     for seam, site in sorted(sites.items()):
-        mod_name, _, qual = site.partition(":")
         try:
-            obj = importlib.import_module(mod_name)
-            for part in qual.split("."):
-                obj = getattr(obj, part)
-            src = inspect.getsource(obj)
+            info = flow.resolve_site(site)
         except Exception:
             # unresolvable sites are the supervise family's finding;
             # double-reporting here would just duplicate the signal
             continue
-        hits = sorted({m.group(0) for m in _TELEM_SERIALIZE.finditer(src)})
-        if hits:
+        calls = flow.serialization_calls(info.node)
+        if calls:
+            hits = sorted({pat for pat, _ in calls})
             findings.append(Finding(
                 rule="telemetry.seam-serialization", entry=site,
                 primitive=f"{seam}: {', '.join(hits)}",
-                count=len(hits),
+                count=len(hits), file=info.file,
+                line=min(ln for _, ln in calls),
                 message=("dispatch seam serializes telemetry inline — "
                          "registry snapshots / telem encoding are "
                          "O(registry)+JSON and this seam runs per chunk; "
@@ -1126,7 +1140,7 @@ def _mesh_family_subprocess(budgets_path: Optional[Path],
     out = json.loads(line)
     findings = [Finding(**{k: f.get(k) for k in
                            ("rule", "entry", "message", "primitive",
-                            "count", "budget")})
+                            "count", "budget", "file", "line")})
                 for f in out.get("findings", [])]
     if rebaseline:
         # parent is re-pinning: the measured counts matter, drift
@@ -1166,18 +1180,28 @@ def run_lint(families: Optional[Sequence[str]] = None,
              budgets_path: Optional[Path] = None,
              rebaseline: bool = False,
              allow_regression: bool = False,
+             deep: bool = False,
+             contracts_path: Optional[Path] = None,
              registry=None, events=None) -> Tuple[List[Finding], Dict]:
     """Run the requested rule families (default: all) against the real
     tree on the current (CPU) backend.  Returns (findings, info); wires
     results into the telemetry registry under `analysis.*` and emits one
     `lint-finding` event per finding when an event sink is given.
 
+    `deep` forces the transfer family's jaxpr host-callback census even
+    when the budget family (whose fused-window trace it would reuse) is
+    not co-selected; without either, the transfer family runs its cheap
+    AST rule only.
+
     The kernel-count pin is a RATCHET: `rebaseline` re-pins measured
     counts as usual, but REFUSES to record a budget whose `total`
     INCREASED over the checked-in value unless `allow_regression` is
     set — every decrement is a wall-clock win on TPU (step cost tracks
     kernel count), so giving one back must be a conscious, named act
-    (`--allow-regression`, recorded in PERF.md)."""
+    (`--allow-regression`, recorded in PERF.md).  contracts.json gets
+    the same treatment through apply_contracts_rebaseline: entry GROWTH
+    (a new undispositioned attribute / hidden coercion / shared write)
+    is refused without `allow_regression`."""
     from wtf_tpu.telemetry import NULL, Registry
 
     registry = registry if registry is not None else Registry()
@@ -1187,10 +1211,12 @@ def run_lint(families: Optional[Sequence[str]] = None,
     if unknown:
         raise ValueError(f"unknown lint families: {sorted(unknown)} "
                          f"(known: {list(FAMILIES)})")
-    if rebaseline and not {"budget", "mesh"} & set(families):
+    if rebaseline and not (_BUDGET_FAMILIES
+                           | _CONTRACT_FAMILIES) & set(families):
         raise ValueError(
-            "--rebaseline rewrites the kernel-count/collective budgets, "
-            "which only the 'budget' and 'mesh' families measure — drop "
+            "--rebaseline rewrites the kernel-count/collective budgets "
+            "and the contract tables, which only the budget/mesh/"
+            "transfer and state/transfer/thread families measure — drop "
             "the families filter or include one of them")
     findings: List[Finding] = []
     info: Dict = {"families": families, "seconds": {}, "entries": []}
@@ -1221,6 +1247,7 @@ def run_lint(families: Optional[Sequence[str]] = None,
 
     compiled = None
     compiled_text = None
+    mega_jaxpr = None
     if "budget" in families:
         t0 = time.time()
         compiled = lowered.compile()
@@ -1273,6 +1300,7 @@ def run_lint(families: Optional[Sequence[str]] = None,
         mega_findings, mega_info = run_megachunk_rules(
             budgets_path=budgets_path, rebaseline=rebaseline)
         findings.extend(mega_findings)
+        mega_jaxpr = mega_info.pop("jaxpr", None)
         counts_m = mega_info["mega_counts"]
         info["mega_kernel_counts"] = counts_m
         info["entries"].append(mega_info["entry"])
@@ -1376,6 +1404,99 @@ def run_lint(families: Optional[Sequence[str]] = None,
         info["entries"].append(
             f"telemetry over SEAM_SITES ({len(SEAM_SITES)} seams)")
         info["seconds"]["telemetry"] = round(time.time() - t0, 1)
+
+    # contract families (state/transfer/thread/contracts) share ONE
+    # pure-AST analysis pass over the tree (analysis/contracts.py on
+    # the flow.py engine) — milliseconds, no device work
+    contract_fams = ({"state", "transfer", "thread", "contracts"}
+                     & set(families))
+    if contract_fams:
+        from wtf_tpu.analysis import contracts as CT
+
+        t0 = time.time()
+        con = CT.load_contracts(contracts_path)
+        state_a = CT.analyze_state()
+        transfer_a = CT.analyze_transfer()
+        thread_a = CT.analyze_thread()
+        info["seconds"]["contract-analysis"] = round(time.time() - t0, 1)
+
+        if "state" in families:
+            t0 = time.time()
+            if not rebaseline:
+                findings.extend(CT.check_state_contracts(
+                    con, analysis=state_a))
+            n_mut = sum(len(a["mutable"]) for a in state_a.values())
+            n_cov = sum(len(set(a["mutable"]) & a["covered"])
+                        for a in state_a.values())
+            registry.gauge("analysis.state_attrs").labels(
+                "mutable").set(n_mut)
+            registry.gauge("analysis.state_attrs").labels(
+                "covered").set(n_cov)
+            info["entries"].append(
+                f"state surface ({len(state_a)} classes, "
+                f"{n_mut} mutable attrs)")
+            info["seconds"]["state"] = round(time.time() - t0, 1)
+
+        if "transfer" in families:
+            t0 = time.time()
+            if not rebaseline:
+                findings.extend(CT.check_transfer_seams(
+                    con, analysis=transfer_a))
+            # the jaxpr census re-traces nothing when the budget family
+            # already ran (mega_jaxpr + runner ride along); standalone
+            # it is the expensive part, so it hides behind --deep
+            census = None
+            if deep or rebaseline or mega_jaxpr is not None:
+                census = CT.measure_transfer_census(
+                    runner=runner, mega_jaxpr=mega_jaxpr)
+                info["transfer_census"] = census
+                for name, value in census.items():
+                    registry.gauge("analysis.transfer_census").labels(
+                        name).set(value)
+                if rebaseline:
+                    measured_budgets[CT.TRANSFER_ENTRY] = {
+                        "entry": CT.TRANSFER_CENSUS_ENTRY, **census}
+                else:
+                    budget = load_budgets(budgets_path).get(
+                        CT.TRANSFER_ENTRY, {})
+                    findings.extend(CT.check_transfer_census(
+                        census, budget,
+                        budgets_file=str(budgets_path or BUDGETS_PATH)))
+            info["entries"].append(
+                "transfer over SEAM_SITES"
+                + (" + jaxpr census" if census is not None else ""))
+            info["seconds"]["transfer"] = round(time.time() - t0, 1)
+
+        if "thread" in families:
+            t0 = time.time()
+            if not rebaseline:
+                findings.extend(CT.check_thread_contracts(
+                    con, analysis=thread_a))
+            n_shared = sum(len(a["shared"]) for a in thread_a.values())
+            registry.gauge("analysis.thread_shared_attrs").set(n_shared)
+            info["entries"].append(
+                f"thread roots ({len(thread_a)} classes, "
+                f"{n_shared} shared attrs)")
+            info["seconds"]["thread"] = round(time.time() - t0, 1)
+
+        if "contracts" in families:
+            t0 = time.time()
+            if not rebaseline:
+                findings.extend(CT.check_contract_hygiene(
+                    con, state_a, transfer_a, thread_a))
+            for section in CT.SECTIONS:
+                n = sum(len(v) for v in con.get(section, {}).values())
+                registry.gauge("analysis.contract_entries").labels(
+                    section).set(n)
+            info["entries"].append("contracts.json hygiene")
+            info["seconds"]["contracts"] = round(time.time() - t0, 1)
+
+        if rebaseline and _CONTRACT_FAMILIES & set(families):
+            needed = CT.needed_contracts(state_a, transfer_a, thread_a)
+            merged = CT.apply_contracts_rebaseline(
+                con, needed, allow_regression=allow_regression)
+            info["contracts_written"] = str(
+                CT.save_contracts(merged, contracts_path))
 
     if rebaseline and measured_budgets:
         budgets = apply_rebaseline(load_budgets(budgets_path),

@@ -556,9 +556,16 @@ class TpuBackend(Backend):
     def restore_coverage_state(self, cov: np.ndarray,
                                edge: np.ndarray) -> None:
         """Install checkpointed aggregate bitmaps.  The mesh backend
-        overrides placement (aggregates live replicated on every chip)."""
+        overrides placement (aggregates live replicated on every chip).
+
+        Drops any pipelined-harvest prelaunch in flight: a window
+        dispatched against pre-restore mutator/cache state could
+        otherwise be adopted after the restore if its signature happens
+        to match (the signature pins batch cursor and cache count, not
+        the restored slab/aggregate contents)."""
         self._agg_cov = jnp.asarray(cov)
         self._agg_edge = jnp.asarray(edge)
+        self._mega_inflight = None
 
     def lane_found_new_coverage(self, lane: int) -> bool:
         return bool(self._new_lane[lane])

@@ -473,8 +473,9 @@ def build_parser() -> argparse.ArgumentParser:
     lint.add_argument("--json", action="store_true",
                       help="machine-readable output (one JSON object)")
     lint.add_argument("--families", default=None,
-                      help="comma list: dtype,budget,recompile,parity "
-                           "(default: all)")
+                      help="comma list: dtype,budget,recompile,parity,"
+                           "mesh,supervise,telemetry,state,transfer,"
+                           "thread,contracts (default: all)")
     lint.add_argument("--budgets", type=Path, default=None,
                       help="alternate budgets.json")
     lint.add_argument("--rebaseline", action="store_true",
@@ -487,6 +488,13 @@ def build_parser() -> argparse.ArgumentParser:
                            "(conscious perf giveback; name it in PERF.md)")
     lint.add_argument("--telemetry-dir", type=Path, default=None,
                       help="write lint findings into events.jsonl")
+    lint.add_argument("--deep", action="store_true",
+                      help="run the transfer family's jaxpr host-transfer"
+                           " census even without the budget family")
+    lint.add_argument("--sarif", type=Path, default=None,
+                      metavar="OUT.json",
+                      help="also write findings as SARIF 2.1.0 (file:line"
+                           " provenance as physical locations)")
     return parser
 
 
@@ -1167,7 +1175,8 @@ def cmd_lint(args) -> int:
         return lint_main(families=families, budgets=args.budgets,
                          rebaseline=args.rebaseline,
                          allow_regression=args.allow_regression,
-                         as_json=args.json,
+                         as_json=args.json, deep=args.deep,
+                         sarif=str(args.sarif) if args.sarif else None,
                          registry=registry, events=events)
 
 

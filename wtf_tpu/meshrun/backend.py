@@ -69,6 +69,9 @@ class MeshBackend(TpuBackend):
         rep = replicated_sharding(self.mesh)
         self._agg_cov = jax.device_put(jnp.asarray(cov), rep)
         self._agg_edge = jax.device_put(jnp.asarray(edge), rep)
+        # same prelaunch-drop contract as the base restore: a window
+        # dispatched pre-restore must never be adopted post-restore
+        self._mega_inflight = None
 
     def print_run_stats(self) -> None:
         super().print_run_stats()
